@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Headline benchmark: batched ed25519 signature verification throughput.
+
+North star (BASELINE.json): tx-sig verifies/sec on a 100k-tx TxSetFrame,
+target >= 25x the libsodium-class CPU path (here: OpenSSL via `cryptography`,
+the same single-verify architecture as the reference's
+PubKeyUtils::verifySig, ref src/crypto/SecretKey.cpp:428).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import time
+
+N = 20_000  # scaled-down batch for the driver; kernel throughput is flat in N
+
+
+def main() -> None:
+    import numpy as np
+
+    from stellar_core_tpu.crypto import SecretKey, sha256
+    from stellar_core_tpu.crypto import ed25519 as ed
+
+    # build a batch of (pubkey, sig, msg) triples — one keypair signing many
+    # distinct 32-byte tx hashes plus a spread of keys, like a TxSetFrame
+    rng = np.random.default_rng(7)
+    keys = [SecretKey(sha256(b"bench%d" % i)) for i in range(64)]
+    pubs, sigs, msgs = [], [], []
+    for i in range(N):
+        sk = keys[i % len(keys)]
+        msg = sha256(b"tx%d" % i)
+        pubs.append(sk.public_key().raw)
+        sigs.append(sk.sign(msg))
+        msgs.append(msg)
+
+    # CPU baseline: sequential OpenSSL verifies (reference architecture)
+    n_base = 2000
+    t0 = time.perf_counter()
+    for i in range(n_base):
+        assert ed.raw_verify(pubs[i], sigs[i], msgs[i])
+    cpu_rate = n_base / (time.perf_counter() - t0)
+
+    # TPU path
+    try:
+        from stellar_core_tpu.ops.ed25519_kernel import verify_batch
+
+        pk = np.frombuffer(b"".join(pubs), dtype=np.uint8).reshape(N, 32)
+        sg = np.frombuffer(b"".join(sigs), dtype=np.uint8).reshape(N, 64)
+        mg = np.frombuffer(b"".join(msgs), dtype=np.uint8).reshape(N, 32)
+        ok = np.asarray(verify_batch(pk, sg, mg))  # compile + warm
+        assert ok.all(), "kernel rejected valid signatures"
+        t0 = time.perf_counter()
+        ok = np.asarray(verify_batch(pk, sg, mg))
+        dt = time.perf_counter() - t0
+        tpu_rate = N / dt
+        print(
+            json.dumps(
+                {
+                    "metric": "ed25519_verifies_per_sec_batched",
+                    "value": round(tpu_rate, 1),
+                    "unit": "verifies/s",
+                    "vs_baseline": round(tpu_rate / cpu_rate, 2),
+                }
+            )
+        )
+    except Exception as e:  # kernel not ready yet — report CPU baseline
+        print(
+            json.dumps(
+                {
+                    "metric": "ed25519_verifies_per_sec_cpu_ref",
+                    "value": round(cpu_rate, 1),
+                    "unit": "verifies/s",
+                    "vs_baseline": 1.0,
+                    "note": f"tpu kernel unavailable: {type(e).__name__}: {e}",
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""stellar_core_tpu — a TPU-native framework with the capabilities of stellar-core.
+
+Not a port: the control plane (SCP/Herder state machines, ledger transactions,
+buckets, history) is host code; the transaction-admission hot path — batched
+ed25519 signature verification and SCP quorum/ballot boolean tallies — runs as
+vmapped/pjit JAX (XLA) kernels on TPU, selected by ``crypto_backend="tpu"`` with
+a CPU path kept as the bit-identical reference backend.
+
+Layout mirrors the reference's layer map (see SURVEY.md §1/§2; reference
+``/root/reference/docs/readme.md:31-103``):
+
+- ``crypto``       — keys, hashing, strkey (ref: src/crypto)
+- ``ops``          — JAX/TPU kernels: ed25519 verify, quorum tallies, SHA-2
+- ``xdr``          — XDR runtime + protocol types (ref: src/protocol-curr/xdr)
+- ``scp``          — Stellar Consensus Protocol, driver pattern (ref: src/scp)
+- ``herder``       — consensus glue: tx queue, tx sets, upgrades (ref: src/herder)
+- ``ledger``       — LedgerTxn, LedgerManager (ref: src/ledger)
+- ``transactions`` — tx/op frames, signature checking (ref: src/transactions)
+- ``bucket``       — BucketList LSM state commitment (ref: src/bucket)
+- ``overlay``      — p2p flood network (ref: src/overlay)
+- ``history``      — checkpoint publish/catchup (ref: src/history, src/catchup)
+- ``work``         — async work-FSM scheduler (ref: src/work)
+- ``invariant``    — apply-time invariant checkers (ref: src/invariant)
+- ``parallel``     — device meshes, shardings, collective helpers
+- ``utils``        — VirtualClock, Scheduler, BitSet, TarjanSCC, metrics
+- ``models``       — composed device pipelines (admission pipeline = flagship)
+- ``main``         — Application container, Config, CLI
+"""
+
+# The device kernels use 64-bit integer limb arithmetic; enable x64 before any
+# jax array is created. Safe for this framework: all device math is integer.
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,17 @@
+"""Crypto foundation (ref: src/crypto — SURVEY.md §2.6).
+
+CPU reference paths live here; batched TPU paths live in
+``stellar_core_tpu.ops``. This module is the ``crypto_backend`` plugin
+boundary: 100%% of tx-signature verification routes through
+:func:`ed25519.verify_sig` (mirrors PubKeyUtils::verifySig,
+ref src/crypto/SecretKey.cpp:428).
+"""
+from .sha import sha256, SHA256, hmac_sha256, hkdf_extract, hkdf_expand  # noqa: F401
+from .ed25519 import SecretKey, PublicKey, verify_sig, sign  # noqa: F401
+from .strkey import (  # noqa: F401
+    encode_ed25519_public_key,
+    decode_ed25519_public_key,
+    encode_ed25519_seed,
+    decode_ed25519_seed,
+)
+from .shorthash import shorthash, shorthash_init  # noqa: F401

@@ -1,0 +1,141 @@
+"""ed25519 sign/verify — the CPU reference backend.
+
+Mirrors the reference's libsodium wrappers (ref: src/crypto/SecretKey.{h,cpp}):
+- :func:`verify_sig` is the single chokepoint all tx-signature verification
+  routes through (ref PubKeyUtils::verifySig, src/crypto/SecretKey.cpp:428),
+  including the random-eviction verify cache (ref :44-47, 65535 entries).
+- Sign/verify primitives are OpenSSL-backed via the ``cryptography`` package;
+  :mod:`stellar_core_tpu.crypto.ed25519_ref` holds a pure-Python
+  implementation of the curve math used as the executable spec for the TPU
+  kernel in :mod:`stellar_core_tpu.ops.ed25519_kernel`.
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+
+from .sha import sha256
+
+# --- verify-sig cache (ref: src/crypto/SecretKey.cpp:44-50) -----------------
+_VERIFY_CACHE_SIZE = 0xFFFF
+_verify_cache: dict[bytes, bool] = {}
+_cache_hits = 0
+_cache_misses = 0
+
+
+def _cache_key(pubkey: bytes, signature: bytes, message: bytes) -> bytes:
+    # ref hashes key+sig+msg into one digest (SecretKey.cpp:50)
+    return sha256(pubkey + signature + message)
+
+
+def verify_cache_stats() -> tuple[int, int]:
+    return _cache_hits, _cache_misses
+
+
+def clear_verify_cache() -> None:
+    global _cache_hits, _cache_misses
+    _verify_cache.clear()
+    _cache_hits = 0
+    _cache_misses = 0
+
+
+def raw_verify(pubkey: bytes, signature: bytes, message: bytes) -> bool:
+    """Uncached single verify (OpenSSL via `cryptography`)."""
+    if len(pubkey) != 32 or len(signature) != 64:
+        return False
+    try:
+        Ed25519PublicKey.from_public_bytes(pubkey).verify(signature, message)
+        return True
+    except (InvalidSignature, ValueError):
+        return False
+
+
+def verify_sig(pubkey: bytes, signature: bytes, message: bytes) -> bool:
+    """Cached verify — the plugin-boundary chokepoint.
+
+    Semantics mirror PubKeyUtils::verifySig (ref src/crypto/SecretKey.cpp:428-459):
+    consult the cache; on miss verify and insert with random eviction.
+    """
+    global _cache_hits, _cache_misses
+    key = _cache_key(pubkey, signature, message)
+    hit = _verify_cache.get(key)
+    if hit is not None:
+        _cache_hits += 1
+        return hit
+    _cache_misses += 1
+    ok = raw_verify(pubkey, signature, message)
+    if len(_verify_cache) >= _VERIFY_CACHE_SIZE:
+        _verify_cache.pop(random.choice(list(_verify_cache.keys())))
+    _verify_cache[key] = ok
+    return ok
+
+
+def sign(seed: bytes, message: bytes) -> bytes:
+    return Ed25519PrivateKey.from_private_bytes(seed).sign(message)
+
+
+@dataclass(frozen=True)
+class PublicKey:
+    """ed25519 public key (ref: src/crypto/SecretKey.h PublicKey = ed25519 key)."""
+
+    raw: bytes
+
+    def __post_init__(self) -> None:
+        if len(self.raw) != 32:
+            raise ValueError("public key must be 32 bytes")
+
+    def verify(self, signature: bytes, message: bytes) -> bool:
+        return verify_sig(self.raw, signature, message)
+
+    def strkey(self) -> str:
+        from .strkey import encode_ed25519_public_key
+
+        return encode_ed25519_public_key(self.raw)
+
+    @property
+    def hint(self) -> bytes:
+        """Last 4 bytes — the DecoratedSignature hint (ref: SignatureUtils)."""
+        return self.raw[-4:]
+
+
+class SecretKey:
+    """ed25519 secret key (ref: src/crypto/SecretKey.h:55)."""
+
+    def __init__(self, seed: bytes) -> None:
+        if len(seed) != 32:
+            raise ValueError("seed must be 32 bytes")
+        self._seed = seed
+        self._priv = Ed25519PrivateKey.from_private_bytes(seed)
+        self._pub = self._priv.public_key().public_bytes_raw()
+
+    @classmethod
+    def random(cls) -> "SecretKey":
+        import os
+
+        return cls(os.urandom(32))
+
+    @classmethod
+    def from_seed_str(cls, name: str) -> "SecretKey":
+        """Deterministic test key from a name (ref: getAccount in test utils)."""
+        return cls(sha256(name.encode()))
+
+    @property
+    def seed(self) -> bytes:
+        return self._seed
+
+    def public_key(self) -> PublicKey:
+        return PublicKey(self._pub)
+
+    def sign(self, message: bytes) -> bytes:
+        return self._priv.sign(message)
+
+    def strkey_seed(self) -> str:
+        from .strkey import encode_ed25519_seed
+
+        return encode_ed25519_seed(self._seed)

@@ -1,0 +1,163 @@
+"""Pure-Python ed25519 verify — executable spec for the TPU kernel.
+
+This is NOT the production CPU path (that is OpenSSL via
+:mod:`stellar_core_tpu.crypto.ed25519`); it exists so the JAX kernel in
+``ops/ed25519_kernel.py`` has a bit-exact, step-inspectable reference for
+every intermediate (field ops, decompression, double-scalar mult), mirroring
+the role libsodium's ref10 plays for the reference (ref:
+src/crypto/SecretKey.cpp:428 crypto_sign_verify_detached).
+
+Verification semantics (cofactorless, matching libsodium >= 1.0.16 and
+OpenSSL for the cases stellar-core produces):
+- reject S >= L (non-canonical scalar)
+- reject non-canonical / off-curve A or R encodings
+- check [S]B == R + [h]A by computing R' = [S]B - [h]A and comparing the
+  canonical encoding of R' against the R bytes.
+"""
+from __future__ import annotations
+
+import hashlib
+
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493
+D = (-121665 * pow(121666, P - 2, P)) % P  # curve constant d
+SQRT_M1 = pow(2, (P - 1) // 4, P)  # sqrt(-1)
+
+# base point
+_By = 4 * pow(5, P - 2, P) % P
+
+
+def _recover_x(y: int, sign: int) -> int | None:
+    """Decompress x from y and sign bit; None if not on curve / non-canonical."""
+    if y >= P:
+        return None
+    u = (y * y - 1) % P
+    v = (D * y * y + 1) % P
+    # candidate root x = u*v^3 * (u*v^7)^((p-5)/8)
+    x = u * pow(v, 3, P) * pow(u * pow(v, 7, P), (P - 5) // 8, P) % P
+    vxx = v * x * x % P
+    if vxx == u:
+        pass
+    elif vxx == (P - u) % P:
+        x = x * SQRT_M1 % P
+    else:
+        return None
+    if x == 0 and sign == 1:
+        return None  # non-canonical: -0
+    if x & 1 != sign:
+        x = P - x
+    return x
+
+
+Bx = _recover_x(_By, 0)
+assert Bx is not None
+B = (Bx, _By)
+
+# Extended coordinates (X, Y, Z, T) with x = X/Z, y = Y/Z, T = XY/Z.
+IDENT = (0, 1, 1, 0)
+
+
+def to_extended(p: tuple[int, int]) -> tuple[int, int, int, int]:
+    x, y = p
+    return (x, y, 1, x * y % P)
+
+
+def point_add(p, q):
+    """Unified extended-coordinate addition (works for doubling too)."""
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = (y1 - x1) * (y2 - x2) % P
+    b = (y1 + x1) * (y2 + x2) % P
+    c = 2 * t1 * t2 * D % P
+    dd = 2 * z1 * z2 % P
+    e = b - a
+    f = dd - c
+    g = dd + c
+    h = b + a
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def point_double(p):
+    """Dedicated doubling (dbl-2008-hwcd): cheaper than unified add."""
+    x1, y1, z1, _ = p
+    a = x1 * x1 % P
+    b = y1 * y1 % P
+    c = 2 * z1 * z1 % P
+    h = (a + b) % P
+    e = (h - (x1 + y1) * (x1 + y1)) % P
+    g = (a - b) % P
+    f = (c + g) % P
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def point_neg(p):
+    x, y, z, t = p
+    return ((P - x) % P, y, z, (P - t) % P)
+
+
+def scalar_mult(k: int, p) -> tuple[int, int, int, int]:
+    acc = IDENT
+    q = p
+    while k:
+        if k & 1:
+            acc = point_add(acc, q)
+        q = point_double(q)
+        k >>= 1
+    return acc
+
+
+def double_scalar_mult(s: int, h: int, neg_a) -> tuple[int, int, int, int]:
+    """[s]B + [h](-A) as one interleaved LSB-first ladder (spec for the kernel loop)."""
+    acc = IDENT
+    bq = to_extended(B)
+    aq = neg_a
+    for i in range(256):
+        if (s >> i) & 1:
+            acc = point_add(acc, bq)
+        if (h >> i) & 1:
+            acc = point_add(acc, aq)
+        bq = point_double(bq)
+        aq = point_double(aq)
+    return acc
+
+
+def encode_point(p) -> bytes:
+    x, y, z, _ = p
+    zi = pow(z, P - 2, P)
+    x, y = x * zi % P, y * zi % P
+    return int.to_bytes(y | ((x & 1) << 255), 32, "little")
+
+
+def decode_point(b: bytes) -> tuple[int, int, int, int] | None:
+    if len(b) != 32:
+        return None
+    yy = int.from_bytes(b, "little")
+    sign = yy >> 255
+    y = yy & ((1 << 255) - 1)
+    x = _recover_x(y, sign)
+    if x is None:
+        return None
+    return to_extended((x, y))
+
+
+def hram(r_bytes: bytes, a_bytes: bytes, message: bytes) -> int:
+    """h = SHA-512(R || A || M) mod L."""
+    return int.from_bytes(hashlib.sha512(r_bytes + a_bytes + message).digest(), "little") % L
+
+
+def verify(pubkey: bytes, signature: bytes, message: bytes) -> bool:
+    if len(pubkey) != 32 or len(signature) != 64:
+        return False
+    r_bytes, s_bytes = signature[:32], signature[32:]
+    s = int.from_bytes(s_bytes, "little")
+    if s >= L:
+        return False
+    a = decode_point(pubkey)
+    if a is None:
+        return False
+    if decode_point(r_bytes) is None:
+        return False
+    h = hram(r_bytes, pubkey, message)
+    # R' = [s]B - [h]A
+    rp = point_add(scalar_mult(s, to_extended(B)), scalar_mult(h, point_neg(a)))
+    return encode_point(rp) == r_bytes

@@ -1,0 +1,28 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip TPU hardware is unavailable in CI; sharding correctness is tested on
+a virtual 8-device CPU mesh (mirrors the reference's strategy of testing
+multi-node behavior in one process — SURVEY.md §4.2, ref
+src/simulation/Simulation.h:29).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import random
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _reseed_prngs():
+    """Deterministic PRNG re-seeding per test (ref: src/test/test.cpp:57-72)."""
+    random.seed(12345)
+    np.random.seed(12345)
+    yield

@@ -1,0 +1,169 @@
+"""Crypto foundation tests (ref test model: src/crypto/test/CryptoTests.cpp)."""
+import hashlib
+
+import pytest
+
+from stellar_core_tpu.crypto import (
+    SecretKey,
+    sha256,
+    hkdf_expand,
+    verify_sig,
+    sign,
+    encode_ed25519_public_key,
+    decode_ed25519_public_key,
+    encode_ed25519_seed,
+    decode_ed25519_seed,
+)
+from stellar_core_tpu.crypto import ed25519 as ed
+from stellar_core_tpu.crypto import ed25519_ref as ref
+from stellar_core_tpu.crypto.shorthash import siphash24
+
+
+def test_sha256_vector():
+    # FIPS 180-2 test vector
+    assert (
+        sha256(b"abc").hex()
+        == "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad"
+    )
+
+
+def test_siphash24_reference_vector():
+    # Reference vector from the SipHash paper, appendix A:
+    # key = 000102...0f, input = 000102...0e (15 bytes)
+    key = bytes(range(16))
+    data = bytes(range(15))
+    assert siphash24(key, data) == 0xA129CA6149BE45E5
+
+
+# RFC 8032 §7.1 TEST 1-3
+RFC8032_VECTORS = [
+    (
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+]
+
+
+@pytest.mark.parametrize("seed,pub,msg,sig", RFC8032_VECTORS)
+def test_rfc8032_sign_verify(seed, pub, msg, sig):
+    seed, pub, msg, sig = (
+        bytes.fromhex(seed),
+        bytes.fromhex(pub),
+        bytes.fromhex(msg),
+        bytes.fromhex(sig),
+    )
+    sk = SecretKey(seed)
+    assert sk.public_key().raw == pub
+    assert sk.sign(msg) == sig
+    assert verify_sig(pub, sig, msg)
+    assert not verify_sig(pub, sig, msg + b"x")
+    # pure-python spec agrees
+    assert ref.verify(pub, sig, msg)
+    assert not ref.verify(pub, sig, msg + b"x")
+
+
+def test_ref_rejects_bad_s():
+    seed, pub, msg, sig = (bytes.fromhex(x) for x in RFC8032_VECTORS[2])
+    bad = sig[:32] + int.to_bytes(ref.L, 32, "little")  # S = L (non-canonical)
+    assert not ref.verify(pub, bad, msg)
+    assert not verify_sig(pub, bad, msg)
+
+
+def test_ref_random_differential():
+    """Pure-python spec vs OpenSSL on random valid/corrupt signatures."""
+    import os
+    import random
+
+    for i in range(20):
+        sk = SecretKey(sha256(b"diff%d" % i))
+        msg = os.urandom(32)
+        sig = sk.sign(msg)
+        pub = sk.public_key().raw
+        assert ref.verify(pub, sig, msg) == ed.raw_verify(pub, sig, msg) == True
+        # corrupt one byte
+        k = random.randrange(64)
+        bad = bytearray(sig)
+        bad[k] ^= 0x40
+        bad = bytes(bad)
+        assert ref.verify(pub, bad, msg) == ed.raw_verify(pub, bad, msg)
+
+
+def test_verify_cache():
+    ed.clear_verify_cache()
+    sk = SecretKey.from_seed_str("cache")
+    msg = b"hello"
+    sig = sk.sign(msg)
+    pub = sk.public_key().raw
+    assert verify_sig(pub, sig, msg)
+    h0, m0 = ed.verify_cache_stats()
+    assert verify_sig(pub, sig, msg)
+    h1, m1 = ed.verify_cache_stats()
+    assert h1 == h0 + 1 and m1 == m0
+
+
+def test_strkey_roundtrip():
+    sk = SecretKey.from_seed_str("strkey")
+    pub = sk.public_key().raw
+    g = encode_ed25519_public_key(pub)
+    assert g.startswith("G")
+    assert decode_ed25519_public_key(g) == pub
+    s = encode_ed25519_seed(sk.seed)
+    assert s.startswith("S")
+    assert decode_ed25519_seed(s) == sk.seed
+
+
+def test_strkey_known_vector():
+    # Well-known Stellar vector: seed/pubkey pair from stellar docs (SEP-23 era)
+    g = "GDW6AUTBXTOC7FIKUO5BOO3OGLK4SF7ZPOBLMQHMZDI45J2Z6VXRB5NR"
+    raw = decode_ed25519_public_key(g)
+    assert encode_ed25519_public_key(raw) == g
+    with pytest.raises(ValueError):
+        decode_ed25519_public_key(g[:-1] + ("A" if g[-1] != "A" else "B"))
+
+
+def test_strkey_rejects_wrong_version():
+    sk = SecretKey.from_seed_str("ver")
+    s = encode_ed25519_seed(sk.seed)
+    with pytest.raises(ValueError):
+        decode_ed25519_public_key(s)
+
+
+def test_hkdf_expand_shape():
+    out = hkdf_expand(b"\x01" * 32, b"info", 64)
+    assert len(out) == 64
+    assert hkdf_expand(b"\x01" * 32, b"info", 64) == out
+
+
+def test_sign_function():
+    sk = SecretKey.from_seed_str("fn")
+    assert sign(sk.seed, b"m") == sk.sign(b"m")
+
+
+def test_ed25519_ref_double_scalar_matches_naive():
+    """double_scalar_mult ladder == separate scalar mults then add."""
+    sk = SecretKey.from_seed_str("dsm")
+    a = ref.decode_point(sk.public_key().raw)
+    na = ref.point_neg(a)
+    s, h = 0xDEADBEEF1234, 0xFEEDFACE5678
+    combined = ref.double_scalar_mult(s, h, na)
+    separate = ref.point_add(
+        ref.scalar_mult(s, ref.to_extended(ref.B)), ref.scalar_mult(h, na)
+    )
+    assert ref.encode_point(combined) == ref.encode_point(separate)
