@@ -1,0 +1,123 @@
+"""The flagship device pipeline: transaction-admission step.
+
+This is the TPU analog of the reference's tx-admission + SCP-tally hot paths
+(SURVEY.md §3.2/§3.3): one XLA program that
+
+  1. verifies a batch of ed25519 signatures (the ``PubKeyUtils::verifySig``
+     seam, ref src/crypto/SecretKey.cpp:428) — data-parallel over the batch;
+  2. runs federated-voting tallies for a batch of candidate statements over
+     the validator universe (the ``LocalNode::isQuorum``/``isVBlocking``
+     seam, ref src/scp/LocalNode.h:58-78) — boolean matrix reductions.
+
+``admission_step`` is the driver's ``entry()``; ``dryrun_sharded`` jits the
+same step over an n-device ``jax.sharding.Mesh`` with data-parallel sharding
+of the signature batch and replicated quorum tensors (DP over sigs is where
+all the FLOPs are; the tally matrices are tiny and ride along replicated —
+the multi-chip layout SURVEY.md §2.17 P5/P6 prescribes).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import quorum as Q
+from ..ops.ed25519_kernel import _verify_impl
+
+
+class AdmissionBatch(NamedTuple):
+    pubkeys: jnp.ndarray   # (S, 32) uint8
+    sigs: jnp.ndarray      # (S, 64) uint8
+    msgs: jnp.ndarray      # (S, 32) uint8
+    qset: Q.QSetTensor     # batched per-node, leading axis N
+    local_qset: Q.QSetTensor  # unbatched (the local node's qset)
+    voted: jnp.ndarray     # (C, N) bool
+    accepted: jnp.ndarray  # (C, N) bool
+
+
+def admission_step(batch: AdmissionBatch):
+    """One fused admission step: sig verify + federated-accept tally.
+
+    Returns (sig_ok (S,) bool, accept (C,) bool, ratify (C,) bool).
+    """
+    sig_ok = _verify_impl(batch.pubkeys, batch.sigs, batch.msgs)
+    ratify = Q.federated_ratify(
+        batch.local_qset, batch.qset, batch.voted | batch.accepted
+    )
+    accept = Q.federated_accept(
+        batch.local_qset, batch.qset, batch.voted, batch.accepted,
+        ratified=ratify,
+    )
+    return sig_ok, accept, ratify
+
+
+def example_batch(n_sigs: int = 8, n_nodes: int = 4) -> tuple:
+    """Build a real example batch (valid signatures, 3-of-4 style quorums)."""
+    from ..crypto import SecretKey, sha256
+
+    pubs, sigs, msgs = [], [], []
+    for i in range(n_sigs):
+        sk = SecretKey(sha256(b"entry%d" % i))
+        m = sha256(b"msg%d" % i)
+        pubs.append(sk.public_key().raw)
+        sigs.append(sk.sign(m))
+        msgs.append(m)
+    pk = np.frombuffer(b"".join(pubs), np.uint8).reshape(n_sigs, 32)
+    sg = np.frombuffer(b"".join(sigs), np.uint8).reshape(n_sigs, 64)
+    mg = np.frombuffer(b"".join(msgs), np.uint8).reshape(n_sigs, 32)
+
+    nodes = list(range(n_nodes))
+    thr = n_nodes - n_nodes // 3  # 2f+1 of 3f+1
+    qsets = [(thr, nodes, []) for _ in nodes]
+    qt = Q.build_qset_tensor(qsets, nodes)
+    local = Q.QSetTensor(
+        qt.top_mem[0], qt.top_thr[0], qt.inner_mem[0], qt.inner_thr[0]
+    )
+    c = 4
+    rng = np.random.default_rng(3)
+    voted = jnp.asarray(rng.random((c, n_nodes)) < 0.8)
+    accepted = jnp.asarray(rng.random((c, n_nodes)) < 0.5)
+    batch = AdmissionBatch(
+        jnp.asarray(pk), jnp.asarray(sg), jnp.asarray(mg),
+        qt, local, voted, accepted,
+    )
+    return (batch,)
+
+
+def dryrun_sharded(n_devices: int) -> None:
+    """jit the full admission step over an n-device mesh and run one step.
+
+    Signature batch is sharded over the ``data`` axis (DP); quorum tensors
+    replicated.  Executes on tiny shapes to validate the multi-chip layout
+    compiles and runs (driver calls this with a virtual CPU mesh).
+    """
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices()[:n_devices])
+    mesh = Mesh(devs, ("data",))
+
+    (batch,) = example_batch(n_sigs=2 * n_devices, n_nodes=4)
+    dp = NamedSharding(mesh, P("data"))
+    rep = NamedSharding(mesh, P())
+
+    def put(x, sh):
+        return jax.device_put(x, sh)
+
+    sharded = AdmissionBatch(
+        put(batch.pubkeys, dp),
+        put(batch.sigs, dp),
+        put(batch.msgs, dp),
+        Q.QSetTensor(*(put(t, rep) for t in batch.qset)),
+        Q.QSetTensor(*(put(t, rep) for t in batch.local_qset)),
+        put(batch.voted, rep),
+        put(batch.accepted, rep),
+    )
+
+    out_shardings = (dp, rep, rep)
+    step = jax.jit(admission_step, out_shardings=out_shardings)
+    sig_ok, accept, ratify = step(sharded)
+    sig_ok.block_until_ready()
+    assert bool(jnp.all(sig_ok)), "sharded verify rejected valid signatures"
+    assert sig_ok.sharding.is_equivalent_to(dp, sig_ok.ndim)

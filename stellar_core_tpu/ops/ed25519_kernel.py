@@ -162,15 +162,17 @@ def decompress(enc: jnp.ndarray):
     v7 = F.mul(F.mul(v3, v3), v)
     x = F.mul(F.mul(u, v3), F.pow22523(F.mul(u, v7)))
     vxx = F.mul(v, F.mul(x, x))
-    on_curve_direct = F.eq(vxx, u)
-    neg_u = F.sub(F.zeros(u.shape[:-1]), u)
-    on_curve_flipped = F.eq(vxx, neg_u)
+    # one freeze per comparison (is_zero of a difference) instead of the
+    # two-freeze eq() — decompress dominates trace size otherwise
+    on_curve_direct = F.is_zero(F.sub(vxx, u))
+    on_curve_flipped = F.is_zero(F.add(vxx, u))
     x = jnp.where(on_curve_flipped[..., None], F.mul(x, _SQRT_M1), x)
     ok = canonical & (on_curve_direct | on_curve_flipped)
 
-    x_is_zero = F.is_zero(x)
+    xf = F.freeze(x)
+    x_is_zero = jnp.all(xf == 0, axis=-1)
     ok = ok & ~(x_is_zero & (sign == 1))
-    flip = (F.parity(x) != sign)[..., None]
+    flip = ((xf[..., 0] & 1) != sign)[..., None]
     x = jnp.where(flip, F.weak_carry(jnp.zeros_like(x) - x), x)
 
     t = F.mul(x, y)
@@ -193,12 +195,25 @@ def encode(p) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 def _build_neg_a_table(neg_a):
-    """16-entry window table [0..15]*(-A): tuple of 4 (..., 16, 22)."""
-    entries = [_ident(neg_a[0].shape[:-1]), neg_a]
-    for _ in range(14):
-        entries.append(point_add(entries[-1], neg_a))
+    """16-entry window table [0..15]*(-A): tuple of 4 (..., 16, 22).
+
+    Built with a lax.scan (14 chained adds) — unrolled, this was the single
+    largest contributor to trace size (22k jaxpr eqns)."""
+
+    def step(acc, _):
+        nxt = point_add(acc, neg_a)
+        return nxt, nxt
+
+    _, rest = jax.lax.scan(step, neg_a, None, length=14)
+    # rest: tuple of 4 arrays (14, ..., 22) -> (..., 14, 22)
+    ident = _ident(neg_a[0].shape[:-1])
     return tuple(
-        jnp.stack([e[i] for e in entries], axis=-2) for i in range(4)
+        jnp.concatenate(
+            [ident[i][..., None, :], neg_a[i][..., None, :],
+             jnp.moveaxis(rest[i], 0, -2)],
+            axis=-2,
+        )
+        for i in range(4)
     )
 
 
@@ -206,8 +221,11 @@ def _verify_impl(pubkeys, sigs, msgs):
     r_bytes = sigs[..., :32]
     s_bytes = sigs[..., 32:]
 
-    a_pt, a_ok = decompress(pubkeys)
-    _, r_ok = decompress(r_bytes)
+    # decompress A and R in one stacked call: traces the (large) decompress
+    # graph once instead of twice
+    both, both_ok = decompress(jnp.stack([pubkeys, r_bytes], axis=0))
+    a_pt = tuple(c[0] for c in both)
+    a_ok, r_ok = both_ok[0], both_ok[1]
     s_ok = S.is_canonical(s_bytes)
 
     # h = SHA512(R || A || M) mod L

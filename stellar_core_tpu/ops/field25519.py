@@ -23,6 +23,7 @@ is this framework's "long sequence").
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -107,15 +108,20 @@ def weak_carry(x: jnp.ndarray, passes: int = 2) -> jnp.ndarray:
 
 
 def _carry_full(x: jnp.ndarray, width: int) -> jnp.ndarray:
-    """Sequential left-to-right carry over `width` limbs (unrolled; width is
-    static).  After this, limbs 0..width-2 are in [0, MASK] and limb width-1
-    holds the (possibly large / signed) remainder."""
-    cols = [x[..., i] for i in range(width)]
-    for i in range(width - 1):
-        carry = cols[i] >> RADIX
-        cols[i] = cols[i] - (carry << RADIX)
-        cols[i + 1] = cols[i + 1] + carry
-    return jnp.stack(cols, axis=-1)
+    """Sequential left-to-right carry over `width` limbs (a lax.scan over the
+    limb axis — unrolling this was a major compile-size cost since freeze()
+    calls it repeatedly).  After this, limbs 0..width-2 are in [0, MASK] and
+    limb width-1 holds the (possibly large / signed) remainder."""
+    xs = jnp.moveaxis(x, -1, 0)  # (width, ...)
+
+    def step(c, xi):
+        s = xi + c
+        cn = s >> RADIX
+        return cn, s - (cn << RADIX)
+
+    c, lo = jax.lax.scan(step, jnp.zeros_like(xs[0]), xs[: width - 1])
+    out = jnp.concatenate([lo, (xs[width - 1] + c)[None]], axis=0)
+    return jnp.moveaxis(out, 0, -1)
 
 
 # ---------------------------------------------------------------------------
@@ -184,9 +190,15 @@ def sqr(a):
 
 
 def _sqr_times(a, n: int):
-    for _ in range(n):
-        a = sqr(a)
-    return a
+    """a^(2^n).  Rolled into a fori_loop: the exponent chains below would
+    otherwise unroll ~500 multiplies at trace time, exploding XLA compile
+    time/memory (observed >5 min, >10 GB on CPU).  One compiled `sqr` body
+    per call site instead."""
+    if n < 4:
+        for _ in range(n):
+            a = sqr(a)
+        return a
+    return jax.lax.fori_loop(0, n, lambda _, x: sqr(x), a)
 
 
 # ---------------------------------------------------------------------------
@@ -230,7 +242,9 @@ def pow22523(z):
 _P_SHIFT_LIMBS = None
 
 
-def _p_shift() -> jnp.ndarray:
+def _p_shift() -> np.ndarray:
+    # cached as a *numpy* array: caching a jnp array created during a jit
+    # trace would leak a tracer into later traces
     global _P_SHIFT_LIMBS
     if _P_SHIFT_LIMBS is None:
         v = P << RADIX
@@ -239,10 +253,11 @@ def _p_shift() -> jnp.ndarray:
             out[i] = v & MASK
             v >>= RADIX
         assert v == 0
-        _P_SHIFT_LIMBS = jnp.asarray(out[:NLIMBS], dtype=jnp.int32)
+        limbs = out[:NLIMBS].astype(np.int32)
         # bits 264.. of p*2^12 live above limb 21; fold them on (19*2^9 rule):
         hi = (P << RADIX) >> (RADIX * NLIMBS)
-        _P_SHIFT_LIMBS = _P_SHIFT_LIMBS.at[0].add(hi * FOLD)
+        limbs[0] += hi * FOLD
+        _P_SHIFT_LIMBS = limbs
     return _P_SHIFT_LIMBS
 
 
@@ -288,13 +303,14 @@ _BITS_TO_LIMBS = None  # (256, 22): limb_j = sum_b bit_b * 2^(b-12j)
 _PARITY = None
 
 
-def _bits_to_limbs_mat() -> jnp.ndarray:
+def _bits_to_limbs_mat() -> np.ndarray:
+    # numpy, not jnp: see _p_shift tracer-leak note
     global _BITS_TO_LIMBS
     if _BITS_TO_LIMBS is None:
         m = np.zeros((256, NLIMBS), dtype=np.int32)
         for b in range(256):
             m[b, b // RADIX] = 1 << (b % RADIX)
-        _BITS_TO_LIMBS = jnp.asarray(m)
+        _BITS_TO_LIMBS = m
     return _BITS_TO_LIMBS
 
 

@@ -10,6 +10,7 @@ TPU-first re-derivation of ref10's sc_reduce (which leans on 64-bit limbs):
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -52,8 +53,11 @@ def reduce512(digest: jnp.ndarray) -> jnp.ndarray:
     (..., 22) canonical 12-bit limbs (matches ref10 sc_reduce semantics)."""
     acc = digest.astype(jnp.int32) @ _POW8  # value < 2^14 * L
     acc = F._carry_full(acc, _WIDTH)
-    for k in range(13, -1, -1):
-        acc = _cond_sub(acc, _LSHIFT[k])
+
+    def step(a, sub_limbs):
+        return _cond_sub(a, sub_limbs), None
+
+    acc, _ = jax.lax.scan(step, acc, _LSHIFT[::-1])
     return acc[..., : F.NLIMBS]
 
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import hashlib
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -151,50 +152,54 @@ def sha512_96(msg: jnp.ndarray) -> jnp.ndarray:
     hi = (w[..., 0] << 24) | (w[..., 1] << 16) | (w[..., 2] << 8) | w[..., 3]
     lo = (w[..., 4] << 24) | (w[..., 5] << 16) | (w[..., 6] << 8) | w[..., 7]
 
-    wh = [hi[..., t] for t in range(16)]
-    wl = [lo[..., t] for t in range(16)]
-    for t in range(16, 80):
-        s0 = _small_sigma0(wh[t - 15], wl[t - 15])
-        s1 = _small_sigma1(wh[t - 2], wl[t - 2])
-        h, l = _add64_many(s1, (wh[t - 7], wl[t - 7]), s0,
-                           (wh[t - 16], wl[t - 16]))
-        wh.append(h)
-        wl.append(l)
+    # --- message schedule: rolling 16-word window under lax.scan.
+    # Unrolling the 64 extension + 80 compression rounds at trace time was
+    # the compile bottleneck (12k+ jaxpr eqns); both loops are scans now.
+    def sched_step(win, _):
+        wh, wl = win  # (..., 16) each; win[..., j] == w[t-16+j]
+        s0 = _small_sigma0(wh[..., 1], wl[..., 1])
+        s1 = _small_sigma1(wh[..., 14], wl[..., 14])
+        h, l = _add64_many(s1, (wh[..., 9], wl[..., 9]), s0,
+                           (wh[..., 0], wl[..., 0]))
+        wh = jnp.concatenate([wh[..., 1:], h[..., None]], axis=-1)
+        wl = jnp.concatenate([wl[..., 1:], l[..., None]], axis=-1)
+        return (wh, wl), (h, l)
+
+    _, (ext_h, ext_l) = jax.lax.scan(
+        sched_step, (hi, lo), None, length=64)
+    # full 80-word schedule, leading word axis: (80, ...)
+    w_h = jnp.concatenate([jnp.moveaxis(hi, -1, 0), ext_h], axis=0)
+    w_l = jnp.concatenate([jnp.moveaxis(lo, -1, 0), ext_l], axis=0)
 
     def bc(v64):
         return (jnp.broadcast_to(jnp.uint32(v64 >> 32), shape),
                 jnp.broadcast_to(jnp.uint32(v64 & 0xFFFFFFFF), shape))
 
-    a, b, c, d, e, f, g, hh = [bc(v) for v in _IV64]
-    for t in range(80):
+    def round_step(regs, xs):
+        a, b, c, d, e, f, g, hh = [(p[0], p[1]) for p in regs]
+        kh, kl, wth, wtl = xs
         ch = (e[0] & f[0]) ^ (~e[0] & g[0]), (e[1] & f[1]) ^ (~e[1] & g[1])
         maj = ((a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
                (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]))
         t1 = _add64_many(hh, _big_sigma1(*e), ch,
-                         (jnp.broadcast_to(_K_HI[t], shape),
-                          jnp.broadcast_to(_K_LO[t], shape)),
-                         (wh[t], wl[t]))
+                         (jnp.broadcast_to(kh, shape),
+                          jnp.broadcast_to(kl, shape)),
+                         (wth, wtl))
         t2 = _add64_many(_big_sigma0(*a), maj)
-        hh = g
-        g = f
-        f = e
-        e = _add64(d[0], d[1], t1[0], t1[1])
-        d = c
-        c = b
-        b = a
-        a = _add64(t1[0], t1[1], t2[0], t2[1])
+        e2 = _add64(d[0], d[1], t1[0], t1[1])
+        a2 = _add64(t1[0], t1[1], t2[0], t2[1])
+        return (a2, a, b, c, e2, e, f, g), None
+
+    init = tuple(bc(v) for v in _IV64)
+    regs, _ = jax.lax.scan(round_step, init, (_K_HI, _K_LO, w_h, w_l))
 
     outs = []
-    for iv, reg in zip(_IV64, (a, b, c, d, e, f, g, hh)):
+    for iv, reg in zip(_IV64, regs):
         ih, il = _pair(iv)
         outs.append(_add64(reg[0], reg[1], jnp.uint32(ih), jnp.uint32(il)))
 
-    # serialize big-endian
-    digest = jnp.zeros((*shape, 64), dtype=jnp.uint8)
-    for i, (h, l) in enumerate(outs):
-        for j, word in enumerate((h, l)):
-            for k in range(4):
-                byte = (word >> (24 - 8 * k)) & 0xFF
-                digest = digest.at[..., i * 8 + j * 4 + k].set(
-                    byte.astype(jnp.uint8))
-    return digest
+    # serialize big-endian: (..., 16) uint32 words -> (..., 64) uint8
+    words = jnp.stack([w for pair in outs for w in pair], axis=-1)
+    shifts = jnp.asarray([24, 16, 8, 0], dtype=jnp.uint32)
+    by = (words[..., :, None] >> shifts) & 0xFF
+    return by.reshape(*shape, 64).astype(jnp.uint8)

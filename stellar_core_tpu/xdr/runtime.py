@@ -1,0 +1,382 @@
+"""XDR (RFC 4506) runtime: declarative type combinators.
+
+The reference generates C++ codecs from the protocol ``.x`` files with
+xdrpp's ``xdrc`` (ref src/Makefile.am:42-47); XDR is the wire *and*
+canonical-hash format for everything (ref docs/architecture.md:52-54).
+This module is the equivalent runtime, redesigned for Python: declarative
+combinator objects with ``pack``/``unpack``, over which
+``stellar_core_tpu.xdr.types`` declares the protocol schema.
+
+Canonicality matters: every codec here round-trips to the unique canonical
+byte form (big-endian, 4-byte alignment, zero padding), so
+``sha256(pack(x))`` is usable as an object id exactly like the reference's
+``xdrSha256`` (ref src/crypto/SHA.h).
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional as Opt, Sequence, Tuple
+
+
+class XdrError(Exception):
+    pass
+
+
+class Reader:
+    __slots__ = ("data", "pos")
+
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        if self.pos + n > len(self.data):
+            raise XdrError("short read")
+        out = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def done(self) -> bool:
+        return self.pos == len(self.data)
+
+
+class XdrType:
+    """Base combinator. pack(value) -> bytes parts appended to out list."""
+
+    def pack(self, v, out: List[bytes]) -> None:
+        raise NotImplementedError
+
+    def unpack(self, r: Reader):
+        raise NotImplementedError
+
+    def encode(self, v) -> bytes:
+        out: List[bytes] = []
+        self.pack(v, out)
+        return b"".join(out)
+
+    def decode(self, data: bytes, allow_trailing: bool = False):
+        r = Reader(data)
+        v = self.unpack(r)
+        if not allow_trailing and not r.done():
+            raise XdrError("trailing bytes")
+        return v
+
+
+def _pad(n: int) -> bytes:
+    return b"\x00" * ((4 - n % 4) % 4)
+
+
+class _IntBase(XdrType):
+    fmt = ">i"
+    lo, hi = -(2**31), 2**31 - 1
+
+    def pack(self, v, out):
+        if not (self.lo <= v <= self.hi):
+            raise XdrError(f"{v} out of range for {type(self).__name__}")
+        out.append(struct.pack(self.fmt, v))
+
+    def unpack(self, r):
+        return struct.unpack(self.fmt, r.take(struct.calcsize(self.fmt)))[0]
+
+
+class IntType(_IntBase):
+    pass
+
+
+class UintType(_IntBase):
+    fmt = ">I"
+    lo, hi = 0, 2**32 - 1
+
+
+class HyperType(_IntBase):
+    fmt = ">q"
+    lo, hi = -(2**63), 2**63 - 1
+
+
+class UhyperType(_IntBase):
+    fmt = ">Q"
+    lo, hi = 0, 2**64 - 1
+
+
+Int = IntType()
+Uint = UintType()
+Hyper = HyperType()
+Uhyper = UhyperType()
+
+
+class BoolType(XdrType):
+    def pack(self, v, out):
+        out.append(struct.pack(">I", 1 if v else 0))
+
+    def unpack(self, r):
+        x = struct.unpack(">I", r.take(4))[0]
+        if x not in (0, 1):
+            raise XdrError("bad bool")
+        return bool(x)
+
+
+Bool = BoolType()
+
+
+class Opaque(XdrType):
+    """Fixed-length opaque[n]."""
+
+    def __init__(self, n: int):
+        self.n = n
+
+    def pack(self, v, out):
+        if len(v) != self.n:
+            raise XdrError(f"opaque[{self.n}] got {len(v)} bytes")
+        out.append(bytes(v))
+        out.append(_pad(self.n))
+
+    def unpack(self, r):
+        v = r.take(self.n)
+        pad = r.take((4 - self.n % 4) % 4)
+        if pad.strip(b"\x00"):
+            raise XdrError("nonzero padding")
+        return v
+
+
+class VarOpaque(XdrType):
+    """opaque<max>."""
+
+    def __init__(self, max_len: int = 2**32 - 1):
+        self.max_len = max_len
+
+    def pack(self, v, out):
+        if len(v) > self.max_len:
+            raise XdrError("opaque too long")
+        out.append(struct.pack(">I", len(v)))
+        out.append(bytes(v))
+        out.append(_pad(len(v)))
+
+    def unpack(self, r):
+        n = struct.unpack(">I", r.take(4))[0]
+        if n > self.max_len:
+            raise XdrError("opaque too long")
+        v = r.take(n)
+        pad = r.take((4 - n % 4) % 4)
+        if pad.strip(b"\x00"):
+            raise XdrError("nonzero padding")
+        return v
+
+
+class XdrStr(VarOpaque):
+    """string<max> — kept as bytes (stellar strings are byte-exact)."""
+
+
+class FixedArray(XdrType):
+    def __init__(self, elem: XdrType, n: int):
+        self.elem, self.n = elem, n
+
+    def pack(self, v, out):
+        if len(v) != self.n:
+            raise XdrError("bad array length")
+        for e in v:
+            self.elem.pack(e, out)
+
+    def unpack(self, r):
+        return [self.elem.unpack(r) for _ in range(self.n)]
+
+
+class VarArray(XdrType):
+    def __init__(self, elem: XdrType, max_len: int = 2**32 - 1):
+        self.elem, self.max_len = elem, max_len
+
+    def pack(self, v, out):
+        if len(v) > self.max_len:
+            raise XdrError("array too long")
+        out.append(struct.pack(">I", len(v)))
+        for e in v:
+            self.elem.pack(e, out)
+
+    def unpack(self, r):
+        n = struct.unpack(">I", r.take(4))[0]
+        if n > self.max_len:
+            raise XdrError("array too long")
+        return [self.elem.unpack(r) for _ in range(n)]
+
+
+class Option(XdrType):
+    """T* — XDR optional (bool + value)."""
+
+    def __init__(self, elem: XdrType):
+        self.elem = elem
+
+    def pack(self, v, out):
+        if v is None:
+            out.append(struct.pack(">I", 0))
+        else:
+            out.append(struct.pack(">I", 1))
+            self.elem.pack(v, out)
+
+    def unpack(self, r):
+        flag = struct.unpack(">I", r.take(4))[0]
+        if flag not in (0, 1):
+            raise XdrError("bad optional flag")
+        return self.elem.unpack(r) if flag else None
+
+
+class Enum(XdrType):
+    """Named int32 with a closed value set."""
+
+    def __init__(self, name: str, values: Dict[str, int]):
+        self.name = name
+        self.by_name = dict(values)
+        self.by_value = {v: k for k, v in values.items()}
+        for k, v in values.items():
+            setattr(self, k, v)
+
+    def pack(self, v, out):
+        if v not in self.by_value:
+            raise XdrError(f"bad {self.name} value {v}")
+        out.append(struct.pack(">i", v))
+
+    def unpack(self, r):
+        v = struct.unpack(">i", r.take(4))[0]
+        if v not in self.by_value:
+            raise XdrError(f"bad {self.name} value {v}")
+        return v
+
+    def nameof(self, v) -> str:
+        return self.by_value[v]
+
+
+class _StructValue:
+    """Generic record: attribute access + equality + repr."""
+
+    __slots__ = ("_fields", "__dict__")
+
+    def __init__(self, _fields: Sequence[str], **kw):
+        self._fields = tuple(_fields)
+        for f in self._fields:
+            setattr(self, f, kw.get(f))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, _StructValue)
+            and self._fields == other._fields
+            and all(
+                getattr(self, f) == getattr(other, f) for f in self._fields
+            )
+        )
+
+    def __repr__(self):
+        body = ", ".join(f"{f}={getattr(self, f)!r}" for f in self._fields)
+        return f"({body})"
+
+    def _replace(self, **kw):
+        vals = {f: getattr(self, f) for f in self._fields}
+        vals.update(kw)
+        return _StructValue(self._fields, **vals)
+
+
+class Struct(XdrType):
+    def __init__(self, name: str, fields: Sequence[Tuple[str, XdrType]]):
+        self.name = name
+        self.fields = list(fields)
+        self.field_names = [f for f, _ in fields]
+
+    def make(self, **kw):
+        unknown = set(kw) - set(self.field_names)
+        if unknown:
+            raise XdrError(f"{self.name}: unknown fields {unknown}")
+        return _StructValue(self.field_names, **kw)
+
+    def pack(self, v, out):
+        for fname, ftype in self.fields:
+            try:
+                ftype.pack(getattr(v, fname), out)
+            except (AttributeError, TypeError, XdrError) as e:
+                raise XdrError(f"{self.name}.{fname}: {e}") from e
+
+    def unpack(self, r):
+        kw = {fname: ftype.unpack(r) for fname, ftype in self.fields}
+        return _StructValue(self.field_names, **kw)
+
+
+class _UnionValue:
+    __slots__ = ("type", "value", "arm")
+
+    def __init__(self, type_, value=None, arm: str = ""):
+        self.type = type_
+        self.value = value
+        self.arm = arm
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, _UnionValue)
+            and self.type == other.type
+            and self.value == other.value
+        )
+
+    def __repr__(self):
+        return f"Union(type={self.type}, {self.arm}={self.value!r})"
+
+
+class Union(XdrType):
+    """Discriminated union.  arms: disc-value -> (arm_name, type|None).
+
+    ``default`` (arm_name, type|None) catches unlisted discriminants.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        disc: XdrType,
+        arms: Dict[int, Tuple[str, Opt[XdrType]]],
+        default: Opt[Tuple[str, Opt[XdrType]]] = None,
+    ):
+        self.name = name
+        self.disc = disc
+        self.arms = dict(arms)
+        self.default = default
+
+    def _arm(self, d):
+        if d in self.arms:
+            return self.arms[d]
+        if self.default is not None:
+            return self.default
+        raise XdrError(f"{self.name}: no arm for discriminant {d}")
+
+    def make(self, d, value=None):
+        arm_name, _ = self._arm(d)
+        return _UnionValue(d, value, arm_name)
+
+    def pack(self, v, out):
+        self.disc.pack(v.type, out)
+        arm_name, arm_type = self._arm(v.type)
+        if arm_type is not None:
+            try:
+                arm_type.pack(v.value, out)
+            except XdrError as e:
+                raise XdrError(f"{self.name}.{arm_name}: {e}") from e
+        elif v.value is not None:
+            raise XdrError(f"{self.name}: void arm carries a value")
+
+    def unpack(self, r):
+        d = self.disc.unpack(r)
+        arm_name, arm_type = self._arm(d)
+        value = arm_type.unpack(r) if arm_type is not None else None
+        return _UnionValue(d, value, arm_name)
+
+
+class Lazy(XdrType):
+    """Forward reference for recursive types (e.g. SCPQuorumSet)."""
+
+    def __init__(self, thunk: Callable[[], XdrType]):
+        self._thunk = thunk
+        self._resolved: Opt[XdrType] = None
+
+    def _get(self) -> XdrType:
+        if self._resolved is None:
+            self._resolved = self._thunk()
+        return self._resolved
+
+    def pack(self, v, out):
+        self._get().pack(v, out)
+
+    def unpack(self, r):
+        return self._get().unpack(r)

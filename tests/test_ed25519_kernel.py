@@ -1,0 +1,86 @@
+"""Batched ed25519 verify kernel vs the executable spec + CPU backend.
+
+Model: the reference pins verify semantics with libsodium; here the batch
+kernel must be bit-identical in accept/reject to crypto/ed25519_ref (the
+executable spec) and crypto/ed25519 (the CPU backend) — including tampered
+signatures, non-canonical encodings, and s >= L malleability rejects
+(SURVEY.md §7 hard parts).
+
+One compiled call covers the whole matrix (single jit, one batch).
+"""
+import numpy as np
+import pytest
+
+from stellar_core_tpu.crypto import SecretKey, sha256
+from stellar_core_tpu.crypto import ed25519 as ed
+from stellar_core_tpu.crypto import ed25519_ref as ref
+
+
+def _mk(n):
+    pubs, sigs, msgs = [], [], []
+    for i in range(n):
+        sk = SecretKey(sha256(b"kern%d" % i))
+        m = sha256(b"kmsg%d" % i)
+        pubs.append(bytearray(sk.public_key().raw))
+        sigs.append(bytearray(sk.sign(m)))
+        msgs.append(bytearray(m))
+    return pubs, sigs, msgs
+
+
+L = 2**252 + 27742317777372353535851937790883648493
+
+
+@pytest.fixture(scope="module")
+def batch_results():
+    from stellar_core_tpu.ops.ed25519_kernel import verify_batch
+
+    pubs, sigs, msgs = _mk(10)
+    # case 0: valid (untouched)
+    # case 1: tampered signature R
+    sigs[1][0] ^= 1
+    # case 2: tampered message
+    msgs[2][0] ^= 1
+    # case 3: tampered pubkey
+    pubs[3][0] ^= 1
+    # case 4: s >= L (add L to s, still < 2^256 — malleability reject)
+    s = int.from_bytes(bytes(sigs[4][32:]), "little") + L
+    sigs[4][32:] = s.to_bytes(32, "little")
+    # case 5: non-canonical R encoding (y = p, encodes as canonical 0 + high)
+    p = 2**255 - 19
+    sigs[5][:32] = p.to_bytes(32, "little")
+    # case 6: non-canonical pubkey (y >= p)
+    pubs[6][:32] = (p + 1).to_bytes(32, "little")
+    # case 7: all-zero signature
+    sigs[7][:] = bytes(64)
+    # case 8: swap of valid sig from another message
+    sigs[8] = bytearray(bytes(sigs[9]))
+    # case 9: valid (control)
+
+    pk = np.frombuffer(b"".join(bytes(p_) for p_ in pubs), np.uint8
+                       ).reshape(10, 32)
+    sg = np.frombuffer(b"".join(bytes(s_) for s_ in sigs), np.uint8
+                       ).reshape(10, 64)
+    mg = np.frombuffer(b"".join(bytes(m_) for m_ in msgs), np.uint8
+                       ).reshape(10, 32)
+    got = np.asarray(verify_batch(pk, sg, mg))
+    want_ref = [ref.verify(bytes(pubs[i]), bytes(sigs[i]), bytes(msgs[i]))
+                for i in range(10)]
+    want_cpu = [ed.raw_verify(bytes(pubs[i]), bytes(sigs[i]), bytes(msgs[i]))
+                for i in range(10)]
+    return got, want_ref, want_cpu
+
+
+def test_kernel_matches_spec(batch_results):
+    got, want_ref, _ = batch_results
+    assert got.tolist() == want_ref
+
+
+def test_kernel_matches_cpu_backend(batch_results):
+    got, _, want_cpu = batch_results
+    assert got.tolist() == want_cpu
+
+
+def test_expected_accept_pattern(batch_results):
+    got, _, _ = batch_results
+    # only the untouched cases are valid
+    assert got.tolist() == [True] + [False] * 8 + [True]

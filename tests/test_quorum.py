@@ -1,0 +1,168 @@
+"""Quorum-set tensor math vs a direct recursive reference.
+
+Model: the reference's SCP unit tests (src/scp/test/SCPUnitTests.cpp)
+exercise isQuorumSlice/isVBlocking/isQuorum over hand-built nested quorum
+sets; here the same properties check the tensorised kernels in
+stellar_core_tpu.ops.quorum against a plain-python evaluator.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from stellar_core_tpu.ops import quorum as Q
+
+
+# plain-python reference semantics (2-level qsets)
+
+def ref_slice(qset, s):
+    thr, vals, inners = qset
+    hits = sum(1 for v in vals if v in s)
+    hits += sum(
+        1
+        for ithr, ivals in inners
+        if ithr > 0 and sum(1 for v in ivals if v in s) >= ithr
+    )
+    return hits >= thr
+
+
+def ref_vblocking(qset, s):
+    thr, vals, inners = qset
+    if thr == 0:
+        return False
+    universe_minus = lambda members: [v for v in members if v not in s]
+    avail = len(universe_minus(vals))
+    avail += sum(
+        1
+        for ithr, ivals in inners
+        if ithr > 0 and len(universe_minus(ivals)) >= ithr
+    )
+    return avail < thr
+
+
+def ref_max_quorum(qsets, members):
+    cur = set(members)
+    while True:
+        nxt = {n for n in cur if ref_slice(qsets[n], cur)}
+        if nxt == cur:
+            return nxt
+        cur = nxt
+
+
+NODES = list(range(6))
+# a mix of flat and nested qsets over 6 nodes
+QSETS = [
+    (2, [0, 1, 2], []),
+    (3, [0, 1, 2, 3], []),
+    (2, [1], [(2, [2, 3, 4]), (1, [5])]),
+    (1, [], [(3, [0, 1, 2, 3])]),
+    (4, [0, 1, 2, 3, 4], []),
+    (2, [4, 5], [(2, [0, 1])]),
+]
+
+
+def qt():
+    return Q.build_qset_tensor(QSETS, NODES)
+
+
+def all_subsets():
+    for mask in range(64):
+        yield {i for i in NODES if mask >> i & 1}
+
+
+def subset_matrix():
+    m = np.zeros((64, 6), np.bool_)
+    for mask in range(64):
+        for i in NODES:
+            m[mask, i] = bool(mask >> i & 1)
+    return jnp.asarray(m)
+
+
+def test_is_quorum_slice_matches_reference():
+    t = qt()
+    sets = subset_matrix()
+    # batch over nodes: evaluate node i's qset against all 64 subsets
+    got = np.asarray(Q.is_quorum_slice(t, jnp.broadcast_to(sets, (6, 64, 6))))
+    for i, qset in enumerate(QSETS):
+        for mask, s in enumerate(all_subsets()):
+            assert got[i, mask] == ref_slice(qset, s), (i, s)
+
+
+def test_is_v_blocking_matches_reference():
+    t = qt()
+    sets = subset_matrix()
+    got = np.asarray(Q.is_v_blocking(t, jnp.broadcast_to(sets, (6, 64, 6))))
+    for i, qset in enumerate(QSETS):
+        for mask, s in enumerate(all_subsets()):
+            assert got[i, mask] == ref_vblocking(qset, s), (i, s)
+
+
+def test_contract_to_maximal_quorum():
+    t = qt()
+    for mask, s in enumerate(all_subsets()):
+        members = jnp.asarray([i in s for i in NODES])
+        got = np.asarray(Q.contract_to_maximal_quorum(t, members))
+        want = ref_max_quorum(QSETS, s)
+        assert {i for i in NODES if got[i]} == want, s
+
+
+def test_threshold_zero_never_blocks():
+    t = Q.build_qset_tensor([(0, [], [])], [0])
+    local = Q.QSetTensor(
+        t.top_mem[0], t.top_thr[0], t.inner_mem[0], t.inner_thr[0]
+    )
+    sets = jnp.asarray([[True]])
+    assert not bool(Q.is_v_blocking(local, sets)[0])
+
+
+def _local(t, i=0):
+    return Q.QSetTensor(
+        t.top_mem[i], t.top_thr[i], t.inner_mem[i], t.inner_thr[i]
+    )
+
+
+def test_federated_ratify_simple_majority():
+    # 4 nodes, 3-of-4 everywhere: a 3-node voted set ratifies, 2-node doesn't
+    nodes = list(range(4))
+    t = Q.build_qset_tensor([(3, nodes, []) for _ in nodes], nodes)
+    voted = jnp.asarray(
+        [[True, True, True, False], [True, True, False, False]]
+    )
+    got = np.asarray(Q.federated_ratify(_local(t), t, voted))
+    assert got.tolist() == [True, False]
+
+
+def test_federated_ratify_requires_local_slice():
+    # Disjoint quorum among remote voters must NOT ratify for the local node
+    # (ref LocalNode::isQuorum filters with the local qset).  Nodes 0,1 form
+    # a 2-of-{0,1} quorum; local node 3 needs 2-of-{2,3}.
+    nodes = list(range(4))
+    qsets = [(2, [0, 1], []), (2, [0, 1], []),
+             (2, [2, 3], []), (2, [2, 3], [])]
+    t = Q.build_qset_tensor(qsets, nodes)
+    voted = jnp.asarray([[True, True, False, False]])
+    local3 = _local(t, 3)
+    assert not bool(Q.federated_ratify(local3, t, voted)[0])
+    # ...and federated_accept must not fire off that phantom quorum either
+    accepted = jnp.zeros_like(voted)
+    assert not bool(Q.federated_accept(local3, t, voted, accepted)[0])
+    # but for node 0 (whose slice is inside {0,1}) it DOES ratify
+    assert bool(Q.federated_ratify(_local(t, 0), t, voted)[0])
+
+
+def test_federated_accept_vblocking_path():
+    # accept via v-blocking acceptance even when vote-quorum is absent
+    nodes = list(range(4))
+    t = Q.build_qset_tensor([(3, nodes, []) for _ in nodes], nodes)
+    local = Q.QSetTensor(
+        t.top_mem[0], t.top_thr[0], t.inner_mem[0], t.inner_thr[0]
+    )
+    # v-blocking for 3-of-4 is any 2 nodes
+    accepted = jnp.asarray([[False, True, True, False]])
+    voted = jnp.asarray([[False, False, False, False]])
+    got = np.asarray(Q.federated_accept(local, t, voted, accepted))
+    assert got.tolist() == [True]
+    # single accepter is not v-blocking and no quorum voted
+    accepted2 = jnp.asarray([[False, True, False, False]])
+    got2 = np.asarray(Q.federated_accept(local, t, voted, accepted2))
+    assert got2.tolist() == [False]
