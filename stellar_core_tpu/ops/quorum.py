@@ -7,9 +7,12 @@ Reference seam: ``LocalNode::isQuorumSlice`` / ``isVBlocking`` / ``isQuorum``
 evaluates one (qset, node-set) pair at a time on CPU.
 
 TPU-first redesign (SURVEY.md §2.17 P6): quorum sets are *tensorised*.
-Stellar quorum sets are at most 2 levels deep (validators + inner sets —
-enforced by the reference's ``isQuorumSetSane``, ref
-src/scp/QuorumSetUtils.cpp), so a node's qset is exactly representable as:
+The tensor form covers 2-level quorum sets (validators + inner sets) — the
+shape every production stellar validator uses (org-grouped validators).
+The wire format legally allows nesting to depth 4
+(ref src/scp/QuorumSetUtils.cpp:16 MAXIMUM_QUORUM_NESTING_LEVEL); deeper
+sets fall back to the exact host-side evaluation in ``scp.local_node``
+(see ``scp.local_node.qset_to_plain``).  A 2-level qset is represented as:
 
   - ``top_mem``   (N,)   bool  — top-level validator membership
   - ``top_thr``   ()     int32 — top-level threshold
@@ -116,14 +119,17 @@ def contract_to_maximal_quorum(
     return out
 
 
-def is_quorum(qsets: QSetTensor, members: jnp.ndarray) -> jnp.ndarray:
-    """Is ``members`` (containing the tallying node's deps) a quorum?
+def is_quorum(local_qs: QSetTensor, qsets: QSetTensor,
+              members: jnp.ndarray) -> jnp.ndarray:
+    """Does ``members`` contain a quorum w.r.t. the local node?
 
-    A non-empty set whose every member's qset is satisfied within the set.
-    returns scalar bool.
+    Matches the host oracle ``scp.local_node.is_quorum``: contract to the
+    maximal sub-quorum, then require it non-empty AND satisfying the local
+    node's slice.  returns scalar bool.
     """
     q = contract_to_maximal_quorum(qsets, members)
-    return jnp.any(q) & jnp.all(q == members)
+    local_ok = is_quorum_slice(local_qs, q[None, :])[0]
+    return jnp.any(q) & local_ok
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,11 @@
+"""Stellar Consensus Protocol — pure, driver-pattern, host-side control
+flow with tensorised tally kernels in ops/quorum.py
+(ref src/scp — SURVEY.md §2.1).
+"""
+from .driver import (  # noqa: F401
+    BALLOT_TIMER, NOMINATION_TIMER, SCPDriver, ValidationLevel,
+)
+from .local_node import LocalNode, make_qset, qset_hash  # noqa: F401
+from .scp import SCP  # noqa: F401
+from .slot import EnvelopeState, Slot  # noqa: F401
+from .ballot import Phase  # noqa: F401

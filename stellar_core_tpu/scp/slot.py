@@ -1,0 +1,157 @@
+"""Slot: per-slot-index consensus state — routes envelopes to the nomination
+or ballot protocol and provides the federated-voting primitives
+(ref src/scp/Slot.h, Slot.cpp).
+"""
+from __future__ import annotations
+
+from enum import IntEnum
+from typing import Callable, Dict, List, Optional
+
+from ..xdr import types as T
+from . import local_node as LN
+from .ballot import BallotProtocol
+from .driver import BALLOT_TIMER, NOMINATION_TIMER  # noqa: F401
+from .nomination import NominationProtocol
+from .statement import companion_qset_hash, node_of, pledge_type
+
+
+class EnvelopeState(IntEnum):
+    INVALID = 0
+    VALID = 1
+
+
+class Slot:
+    def __init__(self, slot_index: int, scp):
+        self.slot_index = slot_index
+        self.scp = scp
+        self.nomination = NominationProtocol(self)
+        self.ballot = BallotProtocol(self)
+        self.fully_validated = scp.local_node.is_validator
+        # historical statements for audit (ref mStatementsHistory)
+        self.statements_history: List = []
+        self.got_v_blocking = False
+
+    # -- plumbing ----------------------------------------------------------
+
+    @property
+    def driver(self):
+        return self.scp.driver
+
+    @property
+    def local_node(self):
+        return self.scp.local_node
+
+    def qset_from_statement(self, st) -> Optional[object]:
+        """Resolve the quorum set a statement pledges under (ref
+        Slot::getQuorumSetFromStatement)."""
+        h = companion_qset_hash(st)
+        if h == self.local_node.qset_hash:
+            return self.local_node.qset
+        return self.driver.get_qset(h)
+
+    def create_envelope(self, pledges) -> object:
+        st = T.SCPStatement.make(
+            nodeID=T.account_id(self.local_node.node_id),
+            slotIndex=self.slot_index,
+            pledges=pledges,
+        )
+        env = T.SCPEnvelope.make(statement=st, signature=b"")
+        self.driver.sign_envelope(env)
+        return env
+
+    # -- envelope entry ----------------------------------------------------
+
+    def process_envelope(self, envelope, self_: bool = False) -> EnvelopeState:
+        st = envelope.statement
+        if st.slotIndex != self.slot_index:
+            raise ValueError("envelope for wrong slot")
+        if pledge_type(st) == T.SCPStatementType.SCP_ST_NOMINATE:
+            res = self.nomination.process_envelope(envelope)
+        else:
+            res = self.ballot.process_envelope(envelope, self_)
+        if res == EnvelopeState.VALID:
+            self.statements_history.append(st)
+        return res
+
+    def nominate(self, value: bytes, prev_value: bytes,
+                 timedout: bool = False) -> bool:
+        return self.nomination.nominate(value, prev_value, timedout)
+
+    def bump_state(self, value: bytes, force: bool) -> bool:
+        return self.ballot.bump_state(value, force)
+
+    def stop_nomination(self) -> None:
+        self.nomination.stop_nomination()
+        self.driver.setup_timer(
+            self.slot_index, NOMINATION_TIMER, 0.0, None)
+
+    def set_fully_validated(self, fv: bool) -> None:
+        self.fully_validated = fv
+
+    def get_latest_composite_candidate(self) -> Optional[bytes]:
+        return self.nomination.latest_composite
+
+    # -- federated voting --------------------------------------------------
+
+    def federated_accept(
+        self,
+        voted_predicate: Callable,
+        accepted_predicate: Callable,
+        envelopes: Dict[bytes, object],
+    ) -> bool:
+        """accept iff a v-blocking set accepts, or a quorum (w.r.t. the
+        local node) votes-or-accepts (ref Slot::federatedAccept)."""
+        accepted_nodes = {
+            n for n, env in envelopes.items()
+            if accepted_predicate(env.statement)
+        }
+        if LN.is_v_blocking(self.local_node.qset, accepted_nodes):
+            return True
+        vote_or_accept = {
+            n for n, env in envelopes.items()
+            if accepted_predicate(env.statement)
+            or voted_predicate(env.statement)
+        }
+        return self._is_quorum(vote_or_accept, envelopes)
+
+    def federated_ratify(
+        self, voted_predicate: Callable, envelopes: Dict[bytes, object]
+    ) -> bool:
+        voted = {
+            n for n, env in envelopes.items()
+            if voted_predicate(env.statement)
+        }
+        return self._is_quorum(voted, envelopes)
+
+    def _is_quorum(self, nodes, envelopes) -> bool:
+        def get_qset(node_id: bytes):
+            env = envelopes.get(node_id)
+            if env is None:
+                return None
+            return self.qset_from_statement(env.statement)
+
+        return LN.is_quorum(nodes, get_qset,
+                            local_qset=self.local_node.qset)
+
+    # -- introspection -----------------------------------------------------
+
+    def get_entire_state(self) -> dict:
+        return {
+            "index": self.slot_index,
+            "nomination": self.nomination.get_json_info(),
+            "ballot": self.ballot.get_json_info(),
+            "fully_validated": self.fully_validated,
+        }
+
+    def latest_messages_send(self) -> List:
+        """Messages to (re)send to peers to advertise current state
+        (ref Slot::getLatestMessagesSend)."""
+        out = []
+        if self.fully_validated:
+            nom = self.nomination.last_envelope_emit
+            if nom is not None:
+                out.append(nom)
+            bal = self.ballot.last_envelope_emit
+            if bal is not None:
+                out.append(bal)
+        return out

@@ -23,12 +23,16 @@ class XdrError(Exception):
     pass
 
 
+MAX_DECODE_DEPTH = 200
+
+
 class Reader:
-    __slots__ = ("data", "pos")
+    __slots__ = ("data", "pos", "depth")
 
     def __init__(self, data: bytes):
         self.data = data
         self.pos = 0
+        self.depth = 0
 
     def take(self, n: int) -> bytes:
         if self.pos + n > len(self.data):
@@ -36,6 +40,17 @@ class Reader:
         out = self.data[self.pos:self.pos + n]
         self.pos += n
         return out
+
+    def enter(self) -> None:
+        """Depth guard for recursive types: adversarial deeply-nested
+        payloads (e.g. a 400-level SCPQuorumSet) must fail with XdrError,
+        not escape as RecursionError."""
+        self.depth += 1
+        if self.depth > MAX_DECODE_DEPTH:
+            raise XdrError("max decode depth exceeded")
+
+    def leave(self) -> None:
+        self.depth -= 1
 
     def done(self) -> bool:
         return self.pos == len(self.data)
@@ -379,4 +394,8 @@ class Lazy(XdrType):
         self._get().pack(v, out)
 
     def unpack(self, r):
-        return self._get().unpack(r)
+        r.enter()
+        try:
+            return self._get().unpack(r)
+        finally:
+            r.leave()

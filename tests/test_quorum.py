@@ -166,3 +166,46 @@ def test_federated_accept_vblocking_path():
     accepted2 = jnp.asarray([[False, True, False, False]])
     got2 = np.asarray(Q.federated_accept(local, t, voted, accepted2))
     assert got2.tolist() == [False]
+
+
+def test_tensor_is_quorum_matches_host_oracle():
+    # disjoint sub-quorum case from the review: members={0,1,2} contract to
+    # {0,1}; local 0 accepts (its slice is inside), local 3 must not
+    from stellar_core_tpu.scp import make_qset
+    from stellar_core_tpu.scp import local_node as LN
+
+    nodes = list(range(4))
+    plain = [(2, [0, 1], []), (2, [0, 1], []),
+             (2, [2, 3], []), (2, [2, 3], [])]
+    t = Q.build_qset_tensor(plain, nodes)
+    members = jnp.asarray([True, True, True, False])
+    got0 = bool(Q.is_quorum(_local(t, 0), t, members))
+    got3 = bool(Q.is_quorum(_local(t, 3), t, members))
+
+    ids = [bytes([i + 1]) * 32 for i in nodes]
+    qsets = {
+        ids[i]: make_qset(thr, [ids[v] for v in vals])
+        for i, (thr, vals, _) in enumerate(plain)
+    }
+    mem_ids = {ids[0], ids[1], ids[2]}
+    want0 = LN.is_quorum(mem_ids, qsets.get, local_qset=qsets[ids[0]])
+    want3 = LN.is_quorum(mem_ids, qsets.get, local_qset=qsets[ids[3]])
+    assert (got0, got3) == (want0, want3) == (True, False)
+
+
+def test_qset_to_plain_depth_fallback():
+    from stellar_core_tpu.scp import make_qset
+    from stellar_core_tpu.scp.local_node import qset_to_plain
+    from stellar_core_tpu.xdr import types as T
+
+    a, b = b"\x01" * 32, b"\x02" * 32
+    two = T.SCPQuorumSet.make(
+        threshold=1, validators=[T.account_id(a)],
+        innerSets=[make_qset(1, [b])])
+    assert qset_to_plain(two) is not None
+    three = T.SCPQuorumSet.make(
+        threshold=1, validators=[],
+        innerSets=[T.SCPQuorumSet.make(
+            threshold=1, validators=[T.account_id(a)],
+            innerSets=[make_qset(1, [b])])])
+    assert qset_to_plain(three) is None

@@ -1,0 +1,290 @@
+"""SCP protocol tests with a scripted fake driver.
+
+Model: src/scp/test/SCPTests.cpp — subclass the driver (no app), script
+envelope sequences from simulated peers, assert on emitted statements and
+state transitions.  5-node network (v0 = local), threshold 4 ("core5").
+"""
+import hashlib
+
+import pytest
+
+from stellar_core_tpu.scp import (
+    SCP, SCPDriver, ValidationLevel, Phase, make_qset, qset_hash,
+)
+from stellar_core_tpu.scp.statement import (
+    ST_PREPARE, ST_CONFIRM, ST_EXTERNALIZE, ST_NOMINATE,
+)
+from stellar_core_tpu.xdr import types as T
+
+V = [bytes([i + 1]) * 32 for i in range(5)]  # node ids v0..v4
+X = hashlib.sha256(b"value-x").digest()
+Y = hashlib.sha256(b"value-y").digest()
+PREV = hashlib.sha256(b"prev").digest()
+
+
+class TestDriver(SCPDriver):
+    __test__ = False
+
+    def __init__(self, qset):
+        self.qset = qset
+        self.qsets = {qset_hash(qset): qset}
+        self.emitted = []
+        self.externalized = {}
+        self.timers = {}
+        self.priority_node = V[0]
+
+    # values
+    def validate_value(self, slot_index, value, nomination):
+        return ValidationLevel.FULLY_VALIDATED
+
+    def combine_candidates(self, slot_index, candidates):
+        # deterministic: lexicographically largest candidate
+        return max(candidates)
+
+    # envelopes
+    def sign_envelope(self, env):
+        env.signature = b"\x01" * 64
+
+    def verify_envelope(self, env):
+        return True
+
+    def emit_envelope(self, env):
+        self.emitted.append(env)
+
+    def get_qset(self, h):
+        return self.qsets.get(h)
+
+    # deterministic leader election: priority_node always wins
+    def compute_hash_node(self, slot_index, prev, is_priority, round_num,
+                          node_id):
+        if is_priority:
+            return 2**63 if node_id == self.priority_node else 1
+        return 0  # everyone is within the neighborhood
+
+    def setup_timer(self, slot_index, timer_id, timeout, cb):
+        self.timers[(slot_index, timer_id)] = (timeout, cb)
+
+    def value_externalized(self, slot_index, value):
+        self.externalized[slot_index] = value
+
+
+def mk_scp():
+    qset = make_qset(4, V)
+    driver = TestDriver(qset)
+    scp = SCP(driver, V[0], True, qset)
+    return scp, driver, qset_hash(qset)
+
+
+def pledges(type_, arm_value):
+    return T.SCPStatementPledges.make(type_, arm_value)
+
+
+def envelope(node, slot, pl):
+    st = T.SCPStatement.make(
+        nodeID=T.account_id(node), slotIndex=slot, pledges=pl)
+    return T.SCPEnvelope.make(statement=st, signature=b"\x01" * 64)
+
+
+def prepare_env(node, slot, qh, ballot, prepared=None, prepared_prime=None,
+                nC=0, nH=0):
+    arm = T.SCPStatementPledges.arms[ST_PREPARE][1].make(
+        quorumSetHash=qh,
+        ballot=T.SCPBallot.make(counter=ballot[0], value=ballot[1]),
+        prepared=None if prepared is None else T.SCPBallot.make(
+            counter=prepared[0], value=prepared[1]),
+        preparedPrime=None if prepared_prime is None else T.SCPBallot.make(
+            counter=prepared_prime[0], value=prepared_prime[1]),
+        nC=nC, nH=nH,
+    )
+    return envelope(node, slot, pledges(ST_PREPARE, arm))
+
+
+def confirm_env(node, slot, qh, ballot, nPrepared, nCommit, nH):
+    arm = T.SCPStatementPledges.arms[ST_CONFIRM][1].make(
+        ballot=T.SCPBallot.make(counter=ballot[0], value=ballot[1]),
+        nPrepared=nPrepared, nCommit=nCommit, nH=nH, quorumSetHash=qh,
+    )
+    return envelope(node, slot, pledges(ST_CONFIRM, arm))
+
+
+def externalize_env(node, slot, qh, commit, nH):
+    arm = T.SCPStatementPledges.arms[ST_EXTERNALIZE][1].make(
+        commit=T.SCPBallot.make(counter=commit[0], value=commit[1]),
+        nH=nH, commitQuorumSetHash=qh,
+    )
+    return envelope(node, slot, pledges(ST_EXTERNALIZE, arm))
+
+
+def nominate_env(node, slot, qh, votes, accepted=()):
+    arm = T.SCPNomination.make(
+        quorumSetHash=qh, votes=sorted(votes), accepted=sorted(accepted))
+    return envelope(node, slot, pledges(ST_NOMINATE, arm))
+
+
+def last_emitted(driver, type_):
+    for env in reversed(driver.emitted):
+        if env.statement.pledges.type == type_:
+            return env
+    return None
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_ballot_protocol_prepare_to_externalize():
+    scp, driver, qh = mk_scp()
+    slot = scp.get_slot(1)
+    b1 = (1, X)
+
+    # start: bump to ballot (1, X); v0 emits PREPARE b=(1,X)
+    assert slot.bump_state(X, True)
+    env = last_emitted(driver, ST_PREPARE)
+    assert env is not None
+    p = env.statement.pledges.value
+    assert (p.ballot.counter, p.ballot.value) == b1
+    assert p.prepared is None
+
+    # quorum votes prepare(1,X) -> v0 accepts prepared(1,X)
+    for v in V[1:4]:
+        scp.receive_envelope(prepare_env(v, 1, qh, b1))
+    env = last_emitted(driver, ST_PREPARE)
+    p = env.statement.pledges.value
+    assert p.prepared is not None
+    assert (p.prepared.counter, p.prepared.value) == b1
+    assert p.nH == 0
+
+    # quorum accepts prepared(1,X) -> v0 confirms prepared: h=c=(1,X)
+    for v in V[1:4]:
+        scp.receive_envelope(prepare_env(v, 1, qh, b1, prepared=b1))
+    env = last_emitted(driver, ST_PREPARE)
+    p = env.statement.pledges.value
+    assert p.nH == 1 and p.nC == 1
+
+    # quorum votes commit [1,1] -> accept commit -> phase CONFIRM
+    for v in V[1:4]:
+        scp.receive_envelope(
+            prepare_env(v, 1, qh, b1, prepared=b1, nC=1, nH=1))
+    env = last_emitted(driver, ST_CONFIRM)
+    assert env is not None
+    c = env.statement.pledges.value
+    assert (c.ballot.counter, c.ballot.value) == b1
+    assert c.nPrepared == 1 and c.nCommit == 1 and c.nH == 1
+    assert slot.ballot.phase == Phase.CONFIRM
+
+    # quorum confirms commit -> externalize
+    for v in V[1:4]:
+        scp.receive_envelope(confirm_env(v, 1, qh, b1, 1, 1, 1))
+    assert slot.ballot.phase == Phase.EXTERNALIZE
+    assert driver.externalized[1] == X
+    env = last_emitted(driver, ST_EXTERNALIZE)
+    e = env.statement.pledges.value
+    assert (e.commit.counter, e.commit.value) == (1, X)
+    assert e.nH == 1
+
+
+def test_ballot_protocol_rejects_stale_statements():
+    scp, driver, qh = mk_scp()
+    scp.get_slot(1)
+    e1 = prepare_env(V[1], 1, qh, (2, X))
+    assert scp.receive_envelope(e1).name == "VALID"
+    # same statement again -> stale
+    assert scp.receive_envelope(e1).name == "INVALID"
+    # lower ballot -> stale
+    e0 = prepare_env(V[1], 1, qh, (1, X))
+    assert scp.receive_envelope(e0).name == "INVALID"
+
+
+def test_ballot_protocol_vblocking_bump():
+    scp, driver, qh = mk_scp()
+    slot = scp.get_slot(1)
+    slot.bump_state(X, True)
+    # v-blocking set (2 nodes of 4-of-5) ahead at counter 3 -> local bumps
+    for v in V[1:3]:
+        scp.receive_envelope(prepare_env(v, 1, qh, (3, X)))
+    assert slot.ballot.current[0] == 3
+
+
+def test_externalize_statement_short_circuit():
+    # EXTERNALIZE from a quorum drives a fresh node straight to externalize
+    scp, driver, qh = mk_scp()
+    slot = scp.get_slot(1)
+    for v in V[1:]:
+        scp.receive_envelope(externalize_env(v, 1, qh, (1, X), 1))
+    assert slot.ballot.phase == Phase.EXTERNALIZE
+    assert driver.externalized[1] == X
+
+
+def test_nomination_to_ballot():
+    scp, driver, qh = mk_scp()
+    slot = scp.get_slot(1)
+
+    # v0 is leader (driver priority): nominate X -> emits NOMINATE votes=[X]
+    assert scp.nominate(1, X, PREV)
+    env = last_emitted(driver, ST_NOMINATE)
+    assert env is not None
+    assert list(env.statement.pledges.value.votes) == [X]
+
+    # quorum votes X -> v0 accepts X -> emits NOMINATE accepted=[X]
+    for v in V[1:4]:
+        scp.receive_envelope(nominate_env(v, 1, qh, [X]))
+    env = last_emitted(driver, ST_NOMINATE)
+    assert list(env.statement.pledges.value.accepted) == [X]
+
+    # quorum accepts X -> candidate -> combine -> ballot protocol starts
+    for v in V[1:4]:
+        scp.receive_envelope(nominate_env(v, 1, qh, [X], accepted=[X]))
+    assert slot.nomination.candidates == {X}
+    env = last_emitted(driver, ST_PREPARE)
+    assert env is not None
+    p = env.statement.pledges.value
+    assert (p.ballot.counter, p.ballot.value) == (1, X)
+
+
+def test_nomination_echoes_leader_votes():
+    scp, driver, qh = mk_scp()
+    driver.priority_node = V[1]  # v1 is the round leader
+    slot = scp.get_slot(1)
+
+    # nominate own value: not leader, nothing to propose yet
+    scp.nominate(1, X, PREV)
+    assert last_emitted(driver, ST_NOMINATE) is None
+
+    # leader proposes Y -> v0 echoes it
+    scp.receive_envelope(nominate_env(V[1], 1, qh, [Y]))
+    env = last_emitted(driver, ST_NOMINATE)
+    assert env is not None
+    assert list(env.statement.pledges.value.votes) == [Y]
+    assert slot.nomination.votes == {Y}
+
+
+def test_nomination_non_leader_values_ignored():
+    scp, driver, qh = mk_scp()
+    driver.priority_node = V[1]
+    scp.nominate(1, X, PREV)
+    # non-leader v2 proposes Y: must not be echoed
+    scp.receive_envelope(nominate_env(V[2], 1, qh, [Y]))
+    assert last_emitted(driver, ST_NOMINATE) is None
+
+
+def test_timer_armed_on_quorum_heard():
+    from stellar_core_tpu.scp import BALLOT_TIMER
+
+    scp, driver, qh = mk_scp()
+    slot = scp.get_slot(1)
+    slot.bump_state(X, True)
+    for v in V[1:4]:
+        scp.receive_envelope(prepare_env(v, 1, qh, (1, X)))
+    # quorum at counter >= 1 heard -> ballot timer armed
+    assert slot.ballot.heard_from_quorum
+    timeout, cb = driver.timers[(1, BALLOT_TIMER)]
+    assert timeout > 0 and cb is not None
+    # firing the timer abandons the ballot -> counter bumps
+    cb()
+    assert slot.ballot.current[0] == 2
+
+
+def test_bad_qset_hash_rejected():
+    scp, driver, qh = mk_scp()
+    unknown = b"\x77" * 32
+    res = scp.receive_envelope(prepare_env(V[1], 1, unknown, (1, X)))
+    assert res.name == "INVALID"

@@ -211,3 +211,21 @@ def test_transaction_result_roundtrip():
     )
     b = T.TransactionResult.encode(res)
     assert T.TransactionResult.decode(b) == res
+
+
+def test_adversarial_nesting_depth_bounded():
+    # a ~400-level-deep SCPQuorumSet must fail with XdrError, not
+    # RecursionError (wire-facing decode contract)
+    inner = T.SCPQuorumSet.make(threshold=1, validators=[], innerSets=[])
+    for _ in range(400):
+        inner = T.SCPQuorumSet.make(
+            threshold=1, validators=[], innerSets=[inner])
+    import sys
+    old = sys.getrecursionlimit()
+    sys.setrecursionlimit(10000)
+    try:
+        data = T.SCPQuorumSet.encode(inner)
+    finally:
+        sys.setrecursionlimit(old)
+    with pytest.raises(XdrError):
+        T.SCPQuorumSet.decode(data)
