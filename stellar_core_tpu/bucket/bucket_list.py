@@ -1,0 +1,227 @@
+"""BucketList: the 11-level LSM of ledger entries whose cumulative hash is
+the ledger's state commitment (ref src/bucket — the 400-line design essay
+at src/bucket/BucketList.h; SURVEY.md §2.7).
+
+Shape mirrors the reference: kNumLevels=11, level capacity 4^(level+1)
+ledgers of changes (levelSize :208-217), half-full spill cadence
+(levelShouldSpill BucketList.h:439).  Each level holds (curr, snap);
+add_batch at each close folds the delta into level 0 and cascades spills.
+
+Representation: a Bucket is an immutable sorted tuple of
+(key-bytes, BucketEntry-value); its hash is sha256 over the canonical XDR
+stream (ref Bucket file hashing).  Merges shadow older entries by key;
+INIT+DEAD annihilate (ref INITENTRY/DEADENTRY semantics at protocol 11+).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..crypto import sha256
+from ..xdr import types as T
+
+NUM_LEVELS = 11
+LEVEL_SIZES = [4 ** (i + 1) for i in range(NUM_LEVELS)]
+
+BET = T.BucketEntryType
+
+
+def level_size(level: int) -> int:
+    return LEVEL_SIZES[level]
+
+
+def level_half(level: int) -> int:
+    return level_size(level) // 2
+
+
+def level_should_spill(ledger_seq: int, level: int) -> bool:
+    """Spill level -> level+1 every half-capacity ledgers
+    (ref BucketList::levelShouldSpill)."""
+    if level == NUM_LEVELS - 1:
+        return False
+    return ledger_seq % level_half(level) == 0
+
+
+class Bucket:
+    """Immutable sorted run of (key, BucketEntry)."""
+
+    __slots__ = ("entries", "_hash")
+
+    EMPTY_HASH = b"\x00" * 32
+
+    def __init__(self, entries: Sequence[Tuple[bytes, object]] = ()):
+        self.entries = tuple(entries)
+        self._hash: Optional[bytes] = None
+
+    def is_empty(self) -> bool:
+        return not self.entries
+
+    def hash(self) -> bytes:
+        if not self.entries:
+            return self.EMPTY_HASH
+        if self._hash is None:
+            h = sha256(
+                b"".join(T.BucketEntry.encode(e) for _, e in self.entries))
+            self._hash = h
+        return self._hash
+
+    @classmethod
+    def fresh(cls, changes: Iterable[Tuple[bytes, Optional[object], bool]],
+              ledger_version: int) -> "Bucket":
+        """Fresh level-0 bucket from one ledger's delta of
+        (key, entry-or-None, existed-before) triples: true creations become
+        INITENTRY, updates of pre-existing entries LIVEENTRY, deletions
+        DEADENTRY (protocol 11+ semantics).  The created/updated
+        distinction matters: DEAD annihilates only against INIT — a DEAD
+        over a LIVE must persist as a tombstone shadowing deeper levels."""
+        out = []
+        for kb, entry, existed in sorted(
+                changes, key=lambda c: c[0]):
+            if entry is None:
+                out.append((kb, T.BucketEntry.make(
+                    BET.DEADENTRY, T.LedgerKey.decode(kb))))
+            elif existed:
+                out.append((kb, T.BucketEntry.make(BET.LIVEENTRY, entry)))
+            else:
+                out.append((kb, T.BucketEntry.make(BET.INITENTRY, entry)))
+        return cls(out)
+
+    @classmethod
+    def merge(cls, newer: "Bucket", older: "Bucket") -> "Bucket":
+        """Two-way sorted merge, newer shadowing older by key; INIT over
+        DEAD(INIT-origin) annihilation per the reference's merge logic."""
+        out: List[Tuple[bytes, object]] = []
+        i = j = 0
+        ne, oe = newer.entries, older.entries
+        while i < len(ne) and j < len(oe):
+            if ne[i][0] < oe[j][0]:
+                out.append(ne[i])
+                i += 1
+            elif ne[i][0] > oe[j][0]:
+                out.append(oe[j])
+                j += 1
+            else:
+                merged = _merge_entry(ne[i][1], oe[j][1])
+                if merged is not None:
+                    out.append((ne[i][0], merged))
+                i += 1
+                j += 1
+        out.extend(ne[i:])
+        out.extend(oe[j:])
+        return cls(out)
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+
+def _merge_entry(new, old):
+    """Resolve a key collision between a newer and older bucket entry
+    (ref Bucket::mergeCasesWithEqualKeys):
+    - DEAD over INIT -> annihilate (entry never existed at this level)
+    - DEAD over LIVE/DEAD -> DEAD
+    - LIVE over INIT -> INIT with the new value (still 'created here')
+    - otherwise keep the newer."""
+    nt, ot = new.type, old.type
+    if nt == BET.DEADENTRY and ot == BET.INITENTRY:
+        return None
+    if nt in (BET.LIVEENTRY, BET.INITENTRY) and ot == BET.INITENTRY:
+        return T.BucketEntry.make(BET.INITENTRY, new.value)
+    return new
+
+
+class BucketLevel:
+    __slots__ = ("curr", "snap")
+
+    def __init__(self):
+        self.curr = Bucket()
+        self.snap = Bucket()
+
+    def hash(self) -> bytes:
+        return sha256(self.curr.hash() + self.snap.hash())
+
+
+class BucketList:
+    def __init__(self):
+        self.levels = [BucketLevel() for _ in range(NUM_LEVELS)]
+
+    def hash(self) -> bytes:
+        """Cumulative commitment: sha256 over all level hashes
+        (ref BucketList::getHash)."""
+        return sha256(b"".join(lv.hash() for lv in self.levels))
+
+    def add_batch(self, ledger_seq: int,
+                  changes: Iterable[Tuple[bytes, Optional[object]]],
+                  ledger_version: int = 19) -> bytes:
+        """Fold one close's delta in; cascade spills (ref addBatch
+        BucketList.h:507).  Returns the new cumulative hash."""
+        # cascade from deepest to shallowest so spills don't double-move
+        for level in range(NUM_LEVELS - 2, -1, -1):
+            if level_should_spill(ledger_seq, level):
+                lv = self.levels[level]
+                nxt = self.levels[level + 1]
+                # snap spills into next.curr (merge); curr becomes snap
+                nxt.curr = Bucket.merge(lv.snap, nxt.curr)
+                lv.snap = lv.curr
+                lv.curr = Bucket()
+        fresh = Bucket.fresh(changes, ledger_version)
+        self.levels[0].curr = Bucket.merge(fresh, self.levels[0].curr)
+        return self.hash()
+
+    # -- state access (catchup / BucketListDB-style lookups) ----------------
+
+    def get_entry(self, kb: bytes):
+        """Most-recent entry for a key across all levels (None if dead or
+        absent) — the BucketIndex lookup path (ref src/bucket/readme.md
+        BucketListDB design)."""
+        for lv in self.levels:
+            for bucket in (lv.curr, lv.snap):
+                e = _bucket_find(bucket, kb)
+                if e is not None:
+                    if e.type == BET.DEADENTRY:
+                        return None
+                    return e.value
+        return None
+
+    def all_live_entries(self) -> Dict[bytes, object]:
+        """Flatten to the live entry set (catchup's ApplyBucketsWork)."""
+        out: Dict[bytes, object] = {}
+        dead: set = set()
+        for lv in self.levels:
+            for bucket in (lv.curr, lv.snap):
+                for kb, e in bucket.entries:
+                    if kb in out or kb in dead:
+                        continue
+                    if e.type == BET.DEADENTRY:
+                        dead.add(kb)
+                    else:
+                        out[kb] = e.value
+        return out
+
+
+def _bucket_find(bucket: Bucket, kb: bytes):
+    """Binary search by key."""
+    import bisect
+
+    keys = [k for k, _ in bucket.entries]
+    i = bisect.bisect_left(keys, kb)
+    if i < len(bucket.entries) and bucket.entries[i][0] == kb:
+        return bucket.entries[i][1]
+    return None
+
+
+class BucketManager:
+    """Owns the bucket list; tracks merges + GC bookkeeping
+    (ref src/bucket/BucketManagerImpl.cpp, simplified: in-memory buckets,
+    no disk files — the persistence story goes through history snapshots)."""
+
+    def __init__(self, app=None):
+        self.app = app
+        self.bucket_list = BucketList()
+
+    def add_batch(self, ledger_seq: int, changes) -> bytes:
+        return self.bucket_list.add_batch(ledger_seq, changes)
+
+    def get_bucket_list_hash(self) -> bytes:
+        return self.bucket_list.hash()
+
+    def snapshot_state(self) -> Dict[bytes, object]:
+        return self.bucket_list.all_live_entries()

@@ -1,0 +1,4 @@
+"""Herder subsystem (ref src/herder — SURVEY.md §2.2)."""
+from .herder import Herder, HerderSCPDriver, HerderState  # noqa: F401
+from .tx_queue import TransactionQueue  # noqa: F401
+from .tx_set import TxSetFrame, surge_pricing_filter  # noqa: F401

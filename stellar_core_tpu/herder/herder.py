@@ -1,0 +1,350 @@
+"""Herder: glue between SCP, the transaction queue, and the ledger
+(ref src/herder/HerderImpl.cpp + HerderSCPDriver.cpp — SURVEY.md §2.2).
+
+States: BOOTING -> TRACKING / NOT-TRACKING (out-of-sync recovery).  Drives
+one SCP round per ledger: triggerNextLedger builds a TxSetFrame from the
+queue, nominates (txSetHash, closeTime), and applies externalized values
+via LedgerManager.  In MANUAL_CLOSE/RUN_STANDALONE mode the SCP round is
+short-circuited (ref Config.RUN_STANDALONE) but the same value/close path
+runs.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from ..ledger.ledger_manager import LedgerCloseData
+from ..scp import SCP, EnvelopeState, SCPDriver, ValidationLevel
+from ..scp.local_node import make_qset, qset_hash
+from ..utils.clock import VirtualTimer
+from ..xdr import types as T, xdr_sha256
+from .tx_queue import TransactionQueue
+from .tx_set import TxSetFrame
+
+# protocol constants (ref src/herder/Herder.cpp:7-18)
+MAX_SCP_TIMEOUT_SECONDS = 240
+CONSENSUS_STUCK_TIMEOUT_SECONDS = 35
+LEDGER_VALIDITY_BRACKET = 100
+MAX_TIME_SLIP_SECONDS = 60
+NODE_EXPIRATION_SECONDS = 240
+SCP_EXTRA_LOOKBACK_LEDGERS = 3
+
+
+class HerderState:
+    BOOTING = 0
+    TRACKING = 1
+    NOT_TRACKING = 2
+
+
+class HerderSCPDriver(SCPDriver):
+    """The only SCPDriver subclass: binds slots to ledger seqs and values
+    to StellarValue XDR (ref src/herder/HerderSCPDriver.cpp)."""
+
+    def __init__(self, herder):
+        self.herder = herder
+        self.app = herder.app
+
+    # -- values ------------------------------------------------------------
+
+    def validate_value(self, slot_index, value, nomination):
+        try:
+            sv = T.StellarValue.decode(value)
+        except Exception:
+            return ValidationLevel.INVALID
+        lm = self.app.ledger_manager
+        if slot_index != lm.last_closed_seq() + 1:
+            # not the slot we're applying next: structurally fine
+            return ValidationLevel.MAYBE_VALID
+        # close time must move forward and not be absurdly in the future
+        lcl = lm.last_closed_header()
+        if sv.closeTime <= lcl.scpValue.closeTime:
+            return ValidationLevel.INVALID
+        if sv.closeTime > self.app.clock.system_now() + \
+                MAX_TIME_SLIP_SECONDS:
+            return ValidationLevel.INVALID
+        tx_set = self.herder.pending_envelopes.get_tx_set(sv.txSetHash)
+        if tx_set is None:
+            return ValidationLevel.MAYBE_VALID
+        if not tx_set.check_valid(lm.root, lm.last_closed_hash()):
+            return ValidationLevel.INVALID
+        if nomination:
+            return ValidationLevel.VOTE_TO_NOMINATE
+        return ValidationLevel.FULLY_VALIDATED
+
+    def combine_candidates(self, slot_index, candidates):
+        """Pick the candidate with max (ops, closeTime, hash) — the
+        reference's protocol-14+ value comparison (ref combineCandidates
+        :615 + compareValues)."""
+        best = None
+        best_key = None
+        for v in candidates:
+            try:
+                sv = T.StellarValue.decode(v)
+            except Exception:
+                continue
+            ts = self.herder.pending_envelopes.get_tx_set(sv.txSetHash)
+            n_ops = ts.size_op() if ts is not None else 0
+            key = (n_ops, sv.closeTime, v)
+            if best_key is None or key > best_key:
+                best_key = key
+                best = v
+        return best
+
+    # -- envelopes ---------------------------------------------------------
+
+    def sign_envelope(self, env) -> None:
+        sk = self.app.config.node_secret()
+        body = T.EnvelopeType.encode(T.EnvelopeType.ENVELOPE_TYPE_SCP) + \
+            self.app.config.network_id() + \
+            T.SCPStatement.encode(env.statement)
+        from ..crypto import sha256
+
+        env.signature = sk.sign(sha256(body))
+
+    def verify_envelope(self, env) -> bool:
+        from ..crypto import sha256, verify_sig
+
+        body = T.EnvelopeType.encode(T.EnvelopeType.ENVELOPE_TYPE_SCP) + \
+            self.app.config.network_id() + \
+            T.SCPStatement.encode(env.statement)
+        return verify_sig(env.statement.nodeID.value, env.signature,
+                          sha256(body))
+
+    def emit_envelope(self, env) -> None:
+        self.herder.broadcast_scp(env)
+
+    def get_qset(self, h: bytes):
+        return self.herder.pending_envelopes.get_qset(h)
+
+    # -- timers ------------------------------------------------------------
+
+    def setup_timer(self, slot_index, timer_id, timeout, cb) -> None:
+        key = (slot_index, timer_id)
+        old = self.herder._scp_timers.pop(key, None)
+        if old is not None:
+            old.cancel()
+        if cb is None or timeout <= 0:
+            return
+        t = VirtualTimer(self.app.clock)
+        t.expires_from_now(timeout)
+        t.async_wait(cb)
+        self.herder._scp_timers[key] = t
+
+    def compute_timeout(self, round_number, is_nomination) -> float:
+        return float(min(round_number + 1, MAX_SCP_TIMEOUT_SECONDS))
+
+    # -- externalization ---------------------------------------------------
+
+    def value_externalized(self, slot_index, value) -> None:
+        self.herder.value_externalized(slot_index, value)
+
+
+class PendingEnvelopes:
+    """Holds SCP envelopes until their tx sets / qsets are available;
+    dedups; feeds ready envelopes to SCP
+    (ref src/herder/PendingEnvelopes.cpp)."""
+
+    def __init__(self, herder):
+        self.herder = herder
+        self.tx_sets: Dict[bytes, TxSetFrame] = {}
+        self.qsets: Dict[bytes, object] = {}
+        self.pending: Dict[bytes, List] = {}  # missing-hash -> envelopes
+
+    def add_tx_set(self, tx_set: TxSetFrame) -> None:
+        h = tx_set.contents_hash()
+        self.tx_sets[h] = tx_set
+        for env in self.pending.pop(h, []):
+            self.herder.scp.receive_envelope(env)
+
+    def add_qset(self, qset) -> None:
+        h = qset_hash(qset)
+        self.qsets[h] = qset
+        for env in self.pending.pop(h, []):
+            self.herder.scp.receive_envelope(env)
+
+    def get_tx_set(self, h: bytes) -> Optional[TxSetFrame]:
+        return self.tx_sets.get(h)
+
+    def get_qset(self, h: bytes):
+        return self.qsets.get(h)
+
+    def missing_for(self, env) -> List[bytes]:
+        from ..scp.statement import companion_qset_hash, pledge_type
+
+        st = env.statement
+        missing = []
+        qh = companion_qset_hash(st)
+        if self.get_qset(qh) is None:
+            missing.append(qh)
+        for vh in _value_tx_set_hashes(st):
+            if self.get_tx_set(vh) is None:
+                missing.append(vh)
+        return missing
+
+    def record_pending(self, env, missing: List[bytes]) -> None:
+        for h in missing:
+            self.pending.setdefault(h, []).append(env)
+
+
+def _value_tx_set_hashes(st) -> List[bytes]:
+    from ..scp import statement as S
+
+    values = []
+    if S.pledge_type(st) == S.ST_NOMINATE:
+        values = S.nomination_values(st)
+    else:
+        values = list(S.ballot_statement_values(st))
+    out = []
+    for v in values:
+        try:
+            sv = T.StellarValue.decode(v)
+            out.append(sv.txSetHash)
+        except Exception:
+            pass
+    return out
+
+
+class Herder:
+    def __init__(self, app):
+        self.app = app
+        self.state = HerderState.BOOTING
+        self.tx_queue = TransactionQueue(app)
+        self.driver = HerderSCPDriver(self)
+        self.pending_envelopes = PendingEnvelopes(self)
+        cfg = app.config
+        qset = self._build_qset(cfg)
+        self.scp = SCP(self.driver, cfg.node_id(),
+                       cfg.NODE_IS_VALIDATOR, qset)
+        self.pending_envelopes.add_qset(qset)
+        self._scp_timers: Dict = {}
+        self.trigger_timer = VirtualTimer(app.clock)
+        self.on_externalized: List[Callable] = []
+        self._tracking_slot: Optional[int] = None
+
+    @staticmethod
+    def _build_qset(cfg):
+        if cfg.QUORUM_SET:
+            return make_qset(
+                cfg.QUORUM_SET["threshold"],
+                cfg.QUORUM_SET["validators"])
+        # standalone: self-quorum
+        return make_qset(1, [cfg.node_id()])
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.state = HerderState.TRACKING
+        if not self.app.config.MANUAL_CLOSE:
+            self._arm_trigger()
+
+    def _arm_trigger(self) -> None:
+        cfg = self.app.config
+        self.trigger_timer.expires_from_now(
+            cfg.EXP_LEDGER_TIMESPAN_SECONDS)
+        self.trigger_timer.async_wait(self.trigger_next_ledger)
+
+    # -- tx admission (north-star hot path #1) ------------------------------
+
+    def recv_transaction(self, env) -> int:
+        """HTTP 'tx' or peer TRANSACTION message -> queue
+        (ref recvTransaction :458)."""
+        res = self.tx_queue.try_add(env)
+        if res == TransactionQueue.ADD_STATUS_PENDING:
+            self.app.broadcast_transaction(env)
+        return res
+
+    # -- SCP plumbing -------------------------------------------------------
+
+    def recv_scp_envelope(self, env) -> EnvelopeState:
+        """ref recvSCPEnvelope :624 + PendingEnvelopes fetch logic."""
+        missing = self.pending_envelopes.missing_for(env)
+        if missing:
+            self.pending_envelopes.record_pending(env, missing)
+            self.app.request_scp_items(missing)
+            return EnvelopeState.VALID
+        return self.scp.receive_envelope(env)
+
+    def recv_tx_set(self, tx_set: TxSetFrame) -> None:
+        self.pending_envelopes.add_tx_set(tx_set)
+
+    def recv_qset(self, qset) -> None:
+        self.pending_envelopes.add_qset(qset)
+
+    def broadcast_scp(self, env) -> None:
+        self.app.broadcast_scp_message(env)
+
+    # -- ledger trigger ----------------------------------------------------
+
+    def trigger_next_ledger(self, max_tx_set_size: Optional[int] = None
+                            ) -> None:
+        """Build the tx set + close value, then nominate
+        (ref triggerNextLedger :1200-1290)."""
+        lm = self.app.ledger_manager
+        lcl_header = lm.last_closed_header()
+        lcl_hash = lm.last_closed_hash()
+        slot = lm.last_closed_seq() + 1
+
+        frames = self.tx_queue.get_transactions()
+        tx_set = TxSetFrame.make_from_transactions(
+            self.app.config.network_id(), lcl_hash, frames, lm.root,
+            max_tx_set_size or lcl_header.maxTxSetSize,
+            lcl_header.baseFee)
+        self.pending_envelopes.add_tx_set(tx_set)
+
+        close_time = max(
+            int(self.app.clock.system_now()),
+            lcl_header.scpValue.closeTime + 1)
+        sv = T.StellarValue.make(
+            txSetHash=tx_set.contents_hash(),
+            closeTime=close_time,
+            upgrades=self._pending_upgrades(),
+            ext=T.StellarValue.fields[3][1].make(
+                T.StellarValueType.STELLAR_VALUE_BASIC))
+        value = T.StellarValue.encode(sv)
+
+        # single-node standalone networks externalize through the same SCP
+        # slot (self-quorum makes the round instant)
+        self.scp.nominate(slot, value, lcl_hash)
+        if not self.app.config.MANUAL_CLOSE:
+            self._arm_trigger()
+
+    def _pending_upgrades(self) -> List[bytes]:
+        return []
+
+    # -- externalization ---------------------------------------------------
+
+    def value_externalized(self, slot_index: int, value: bytes) -> None:
+        """ref valueExternalized :315 + processExternalized :266."""
+        sv = T.StellarValue.decode(value)
+        tx_set = self.pending_envelopes.get_tx_set(sv.txSetHash)
+        if tx_set is None:
+            raise RuntimeError("externalized value with unknown tx set")
+        self.state = HerderState.TRACKING
+        self._tracking_slot = slot_index
+        lm = self.app.ledger_manager
+        if slot_index == lm.last_closed_seq() + 1:
+            lm.close_ledger(LedgerCloseData(slot_index, tx_set, sv))
+            self.ledger_closed(slot_index)
+        else:
+            # gapped: buffer only — housekeeping runs per actually-closed
+            # ledger via ledger_closed (aging the queue for slots we never
+            # applied would wrongly ban pending txs)
+            self.app.catchup_manager.buffer_externalized(
+                slot_index, tx_set, sv)
+        for cb in self.on_externalized:
+            cb(slot_index, sv)
+
+    def ledger_closed(self, slot_index: int) -> None:
+        """Housekeeping after a ledger actually closes locally (also called
+        by the catchup manager when it drains buffered ledgers)."""
+        lm = self.app.ledger_manager
+        self.tx_queue.shift(lm.root)
+        self.scp.purge_slots(
+            max(0, slot_index - SCP_EXTRA_LOOKBACK_LEDGERS), slot_index)
+
+    # -- manual close (test/standalone) -------------------------------------
+
+    def manual_close(self) -> int:
+        """Close exactly one ledger now (ref CommandHandler manualclose)."""
+        assert self.app.config.MANUAL_CLOSE
+        self.trigger_next_ledger()
+        return self.app.ledger_manager.last_closed_seq()

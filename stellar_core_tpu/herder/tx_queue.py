@@ -1,0 +1,134 @@
+"""TransactionQueue: the pre-consensus mempool, per source account, with
+age/ban/shift lifecycle (ref src/herder/TransactionQueue.h:34-139).
+
+Each account holds a seq-ordered chain of pending txs; entries age with
+each ledger (shift) and are dropped at age limit; invalid/banned txs are
+rejected with try-again-later semantics.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..ledger.ledger_txn import LedgerTxn
+from ..transactions import TransactionFrame
+from ..transactions.frame import TC
+
+
+class AccountTxs:
+    __slots__ = ("frames", "age")
+
+    def __init__(self):
+        self.frames: List[TransactionFrame] = []
+        self.age = 0
+
+
+class TransactionQueue:
+    ADD_STATUS_PENDING = 0
+    ADD_STATUS_DUPLICATE = 1
+    ADD_STATUS_ERROR = 2
+    ADD_STATUS_TRY_AGAIN_LATER = 3
+    ADD_STATUS_BANNED = 4
+
+    PENDING_DEPTH = 4        # max age (ref pendingDepth)
+    BAN_DEPTH = 10           # ledgers a banned tx stays banned
+    MAX_PER_ACCOUNT = 112    # queue limit per account (v19 default ~)
+
+    def __init__(self, app):
+        self.app = app
+        self.accounts: Dict[bytes, AccountTxs] = {}
+        self.banned: List[set] = [set() for _ in range(self.BAN_DEPTH)]
+        self.known: Dict[bytes, TransactionFrame] = {}
+
+    # -- admission ---------------------------------------------------------
+
+    def try_add(self, env) -> int:
+        """ref tryAdd :130 — the north-star admission path."""
+        network_id = self.app.config.network_id()
+        try:
+            frame = TransactionFrame(network_id, env)
+        except Exception:
+            return self.ADD_STATUS_ERROR
+        h = frame.full_hash()
+        if h in self.known:
+            return self.ADD_STATUS_DUPLICATE
+        if any(h in b for b in self.banned):
+            return self.ADD_STATUS_BANNED
+
+        src = frame.source_account_id()
+        acct = self.accounts.get(src)
+        lm = self.app.ledger_manager
+
+        # seq continuity: must extend the chain (account seq + queued txs)
+        with LedgerTxn(lm.root) as ltx:
+            entry = ltx.load_account(src)
+            base_seq = entry.data.value.seqNum if entry else None
+            expected = base_seq
+            if acct is not None and acct.frames:
+                expected = acct.frames[-1].seq_num()
+            if base_seq is None:
+                ltx.rollback()
+                return self.ADD_STATUS_ERROR
+            if frame.seq_num() != expected + 1:
+                ltx.rollback()
+                return self.ADD_STATUS_TRY_AGAIN_LATER
+            # full validity, treating queued predecessors as applied
+            res = frame.check_valid(ltx, current_seq=expected)
+            ltx.rollback()
+        if not res.ok:
+            return self.ADD_STATUS_ERROR
+
+        if acct is None:
+            acct = self.accounts[src] = AccountTxs()
+        if len(acct.frames) >= self.MAX_PER_ACCOUNT:
+            return self.ADD_STATUS_TRY_AGAIN_LATER
+        acct.frames.append(frame)
+        self.known[h] = frame
+        self.app.metrics.counter("herder.pending-txs.count").inc()
+        return self.ADD_STATUS_PENDING
+
+    # -- harvesting --------------------------------------------------------
+
+    def get_transactions(self) -> List[TransactionFrame]:
+        out: List[TransactionFrame] = []
+        for acct in self.accounts.values():
+            out.extend(acct.frames)
+        return out
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def shift(self, ltx_root) -> None:
+        """Post-close: drop applied/invalidated txs, age the rest, ban
+        expired ones (ref shift :139 + removeApplied)."""
+        self.banned.pop()
+        self.banned.insert(0, set())
+        with LedgerTxn(ltx_root) as ltx:
+            for src in list(self.accounts):
+                acct = self.accounts[src]
+                entry = ltx.load_account(src)
+                seq = entry.data.value.seqNum if entry else -1
+                kept = [f for f in acct.frames if f.seq_num() > seq]
+                dropped = [f for f in acct.frames if f.seq_num() <= seq]
+                for f in dropped:
+                    self.known.pop(f.full_hash(), None)
+                acct.frames = kept
+                if dropped:
+                    acct.age = 0  # account made progress
+                else:
+                    acct.age += 1
+                if acct.age >= self.PENDING_DEPTH:
+                    for f in acct.frames:
+                        self.known.pop(f.full_hash(), None)
+                        self.banned[0].add(f.full_hash())
+                    acct.frames = []
+                if not acct.frames:
+                    if acct.age >= self.PENDING_DEPTH or not kept:
+                        self.accounts.pop(src, None)
+            ltx.rollback()
+        self.app.metrics.counter("herder.pending-txs.count").set_count(
+            len(self.known))
+
+    def is_banned(self, tx_hash: bytes) -> bool:
+        return any(tx_hash in b for b in self.banned)
+
+    def size(self) -> int:
+        return len(self.known)

@@ -1,0 +1,260 @@
+"""TxSetFrame: the consensus value — an ordered transaction set + hash
+(ref src/herder/TxSetFrame.cpp — SURVEY.md §2.2).
+
+Build from the local queue (``make_from_transactions``: sort-by-hash, surge
+pricing, per-tx validity) or from the wire (``make_from_wire``: structural
+re-validation).  ``txs_in_apply_order`` is the deterministic shuffle that
+keeps per-account sequence order (ref getTxsInApplyOrder :503).
+
+TPU batch hook: ``prevalidate_signatures`` collects every signature in the
+set and verifies them as ONE device batch (ops/ed25519_kernel), feeding
+per-signature verdicts into the frames' SignatureCheckers — the admission
+hot path P5 (SURVEY.md §2.17).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..crypto import sha256
+from ..ledger.ledger_txn import LedgerTxn
+from ..transactions import TransactionFrame
+from ..transactions.frame import TC
+from ..xdr import types as T, xdr_sha256
+
+
+class TxSetFrame:
+    def __init__(self, network_id: bytes, previous_ledger_hash: bytes,
+                 frames: Sequence[TransactionFrame]):
+        self.network_id = network_id
+        self.previous_ledger_hash = previous_ledger_hash
+        # canonical order: sorted by full hash (ref sortTxsInHashOrder)
+        self.frames = sorted(frames, key=lambda f: f.full_hash())
+        self._hash: Optional[bytes] = None
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def make_from_transactions(cls, network_id: bytes, lcl_hash: bytes,
+                               frames: Sequence[TransactionFrame],
+                               ltx_root, max_size: int,
+                               base_fee: int) -> "TxSetFrame":
+        """Filter invalid txs, trim to max_size by fee rate (surge pricing),
+        keep per-account seq continuity (ref makeFromTransactions :234)."""
+        # per-source continuity: keep the longest valid prefix per account
+        by_source: Dict[bytes, List[TransactionFrame]] = {}
+        for f in frames:
+            by_source.setdefault(f.source_account_id(), []).append(f)
+        valid: List[TransactionFrame] = []
+        with LedgerTxn(ltx_root) as ltx:
+            for source, fs in by_source.items():
+                fs.sort(key=lambda f: f.seq_num())
+                entry = ltx.load_account(source)
+                seq = entry.data.value.seqNum if entry else None
+                for f in fs:
+                    if seq is None or f.seq_num() != seq + 1:
+                        break
+                    res = f.check_valid(ltx, current_seq=seq)
+                    if not res.ok:
+                        break
+                    valid.append(f)
+                    seq = f.seq_num()
+            ltx.rollback()
+        valid = surge_pricing_filter(valid, max_size)
+        return cls(network_id, lcl_hash, valid)
+
+    @classmethod
+    def make_from_wire(cls, network_id: bytes, xdr_tx_set) -> "TxSetFrame":
+        frames = [TransactionFrame(network_id, env)
+                  for env in xdr_tx_set.txs]
+        return cls(network_id, xdr_tx_set.previousLedgerHash, frames)
+
+    # -- identity ----------------------------------------------------------
+
+    def to_xdr(self):
+        return T.TransactionSet.make(
+            previousLedgerHash=self.previous_ledger_hash,
+            txs=[f.envelope for f in self.frames])
+
+    def contents_hash(self) -> bytes:
+        if self._hash is None:
+            self._hash = xdr_sha256(T.TransactionSet, self.to_xdr())
+        return self._hash
+
+    def size(self) -> int:
+        return len(self.frames)
+
+    def size_op(self) -> int:
+        return sum(f.num_operations() for f in self.frames)
+
+    # -- validity (wire sets) ----------------------------------------------
+
+    def check_valid(self, ltx_root, lcl_hash: bytes,
+                    verify=None) -> bool:
+        """ref TxSetFrame::checkValid :562 — prev-hash linkage, hash order,
+        per-source seq continuity, per-tx checkValid."""
+        if self.previous_ledger_hash != lcl_hash:
+            return False
+        hashes = [f.full_hash() for f in self.frames]
+        if hashes != sorted(hashes):
+            return False
+        by_source: Dict[bytes, List[TransactionFrame]] = {}
+        for f in self.frames:
+            by_source.setdefault(f.source_account_id(), []).append(f)
+        with LedgerTxn(ltx_root) as ltx:
+            ok = True
+            for source, fs in by_source.items():
+                fs.sort(key=lambda f: f.seq_num())
+                entry = ltx.load_account(source)
+                if entry is None:
+                    ok = False
+                    break
+                seq = entry.data.value.seqNum
+                for f in fs:
+                    if f.seq_num() != seq + 1:
+                        ok = False
+                        break
+                    res = f.check_valid(ltx, current_seq=seq,
+                                        verify=verify)
+                    if not res.ok:
+                        ok = False
+                        break
+                    seq = f.seq_num()
+                if not ok:
+                    break
+            ltx.rollback()
+        return ok
+
+    # -- apply order -------------------------------------------------------
+
+    def txs_in_apply_order(self) -> List[TransactionFrame]:
+        """Deterministic shuffle preserving per-account seq order: slot
+        positions come from sha256(lcl || txhash) order; each account's txs
+        fill its own positions in sequence order (ref ApplyTxSorter)."""
+        def shuffle_key(f: TransactionFrame) -> bytes:
+            return sha256(self.previous_ledger_hash + f.full_hash())
+
+        shuffled = sorted(self.frames, key=shuffle_key)
+        by_source: Dict[bytes, List[TransactionFrame]] = {}
+        for f in self.frames:
+            by_source.setdefault(f.source_account_id(), []).append(f)
+        for fs in by_source.values():
+            fs.sort(key=lambda f: f.seq_num())
+        iters = {src: iter(fs) for src, fs in by_source.items()}
+        return [next(iters[f.source_account_id()]) for f in shuffled]
+
+    # -- TPU batch pre-verification ----------------------------------------
+
+    def collect_signature_batch(self) -> Tuple:
+        """Gather (pubkey, sig, payload-hash) triples for every
+        (signature x candidate-signer) pair whose hint matches — the batch
+        the device kernel verifies in one shot."""
+        import numpy as np
+
+        from ..transactions.signature_checker import signature_hint
+
+        triples = []
+        index = []
+        for fi, f in enumerate(self.frames):
+            h = f.full_hash()
+            src = f.source_account_id()
+            # candidate signer keys: tx source + op sources (master keys);
+            # additional account signers resolve at check time via cache
+            # misses falling back to CPU verify
+            keys = {src}
+            for opf in f.op_frames:
+                keys.add(opf.source_account_id())
+            for i, ds in enumerate(f.signatures):
+                for pub in keys:
+                    if ds.hint == signature_hint(pub):
+                        triples.append((pub, ds.signature, h))
+                        index.append((fi, i, pub))
+        return triples, index
+
+    def prevalidate_signatures(self, use_device: bool = True
+                               ) -> Dict[Tuple[bytes, bytes, bytes], bool]:
+        """Verify the whole set's signatures as one batch; returns a verdict
+        cache keyed by (pubkey, signature, msg) for SignatureChecker."""
+        triples, _ = self.collect_signature_batch()
+        if not triples:
+            return {}
+        verdicts: Dict[Tuple[bytes, bytes, bytes], bool] = {}
+        if use_device:
+            import numpy as np
+
+            from ..ops.ed25519_kernel import verify_batch
+
+            n = len(triples)
+            pk = np.frombuffer(
+                b"".join(t[0] for t in triples), np.uint8).reshape(n, 32)
+            sg = np.frombuffer(
+                b"".join(t[1].ljust(64, b"\x00") for t in triples),
+                np.uint8).reshape(n, 64)
+            mg = np.frombuffer(
+                b"".join(t[2] for t in triples), np.uint8).reshape(n, 32)
+            ok = np.asarray(verify_batch(pk, sg, mg))
+            for t, v in zip(triples, ok):
+                verdicts[(t[0], t[1], t[2])] = bool(v)
+        else:
+            from ..crypto import verify_sig
+
+            for pub, sig, msg in triples:
+                verdicts[(pub, sig, msg)] = verify_sig(pub, sig, msg)
+        return verdicts
+
+    def make_cached_verify(self, verdicts):
+        """verify callable for SignatureChecker: batch verdicts first,
+        CPU fallback for pairs outside the batch (e.g. extra signers)."""
+        from ..crypto import verify_sig
+
+        def verify(pub: bytes, sig: bytes, msg: bytes) -> bool:
+            key = (pub, sig, msg)
+            if key in verdicts:
+                return verdicts[key]
+            return verify_sig(pub, sig, msg)
+
+        return verify
+
+
+def surge_pricing_filter(frames: List[TransactionFrame],
+                         max_ops: int) -> List[TransactionFrame]:
+    """Trim to the ledger's op capacity by fee-per-op rate, highest first
+    (ref applySurgePricing :1150 / SurgePricingUtils.h priority queue).
+    Per-account seq chains are kept intact: dropping a tx drops its
+    successors."""
+    total_ops = sum(f.num_operations() for f in frames)
+    if total_ops <= max_ops:
+        return list(frames)
+
+    def rate(f: TransactionFrame) -> Tuple:
+        # fee-per-op, tie-break by hash for determinism
+        return (-f.fee_bid() / max(1, f.num_operations()), f.full_hash())
+
+    by_source: Dict[bytes, List[TransactionFrame]] = {}
+    for f in frames:
+        by_source.setdefault(f.source_account_id(), []).append(f)
+    for fs in by_source.values():
+        fs.sort(key=lambda f: f.seq_num())
+
+    kept: set = set()
+    kept_order: List[TransactionFrame] = []
+    ops = 0
+    dropped_sources = set()
+    for f in sorted(frames, key=rate):
+        src = f.source_account_id()
+        if src in dropped_sources or id(f) in kept:
+            continue
+        chain = by_source[src]
+        pos = chain.index(f)
+        # a high-fee successor pulls its not-yet-kept (cheaper)
+        # predecessors in with it — seq chains stay intact
+        prefix = [c for c in chain[:pos + 1] if id(c) not in kept]
+        prefix_ops = sum(c.num_operations() for c in prefix)
+        if ops + prefix_ops > max_ops:
+            dropped_sources.add(src)
+            continue
+        for c in prefix:
+            kept.add(id(c))
+            kept_order.append(c)
+        ops += prefix_ops
+    return kept_order

@@ -1,0 +1,102 @@
+"""Invariant framework: pluggable post-condition checkers run on apply
+(ref src/invariant — SURVEY.md §2.13).
+
+A failed strict invariant raises InvariantDoesNotHold => node crash
+(safety-first, like the reference).  Registered by config regex.
+"""
+from __future__ import annotations
+
+import re
+from typing import List
+
+from ..transactions import utils as U
+from ..xdr import types as T
+
+
+class InvariantDoesNotHold(Exception):
+    pass
+
+
+class Invariant:
+    NAME = "invariant"
+
+    def check_on_tx_apply(self, ltx, frame, ok: bool) -> str:
+        """Return '' when the invariant holds, else a description."""
+        return ""
+
+
+class LedgerEntryIsValid(Invariant):
+    """Structural validity of touched entries
+    (ref src/invariant/LedgerEntryIsValid.cpp)."""
+
+    NAME = "LedgerEntryIsValid"
+
+    def check_on_tx_apply(self, ltx, frame, ok: bool) -> str:
+        for kb, entry in ltx._delta.items():
+            if entry is None:
+                continue
+            d = entry.data
+            if d.type == T.LedgerEntryType.ACCOUNT:
+                acc = d.value
+                if acc.balance < 0:
+                    return f"account balance negative: {acc.balance}"
+                if acc.seqNum < 0:
+                    return "account seqnum negative"
+                if len(acc.signers) > T.MAX_SIGNERS:
+                    return "too many signers"
+            elif d.type == T.LedgerEntryType.TRUSTLINE:
+                tl = d.value
+                if tl.balance < 0 or tl.balance > tl.limit:
+                    return "trustline balance out of [0, limit]"
+            elif d.type == T.LedgerEntryType.OFFER:
+                off = d.value
+                if off.amount <= 0:
+                    return "offer amount non-positive"
+                if off.price.n <= 0 or off.price.d <= 0:
+                    return "offer price non-positive"
+        return ""
+
+
+class ConservationOfLumens(Invariant):
+    """Native lumens only move, never appear (ref
+    src/invariant/ConservationOfLumens.cpp): per-tx delta of account
+    balances + feePool must be zero (inflation aside)."""
+
+    NAME = "ConservationOfLumens"
+
+    def check_on_tx_apply(self, ltx, frame, ok: bool) -> str:
+        delta = 0
+        for kb, entry in ltx._delta.items():
+            old = ltx.parent.get(kb)
+            new_bal = old_bal = 0
+            if entry is not None and \
+                    entry.data.type == T.LedgerEntryType.ACCOUNT:
+                new_bal = entry.data.value.balance
+            if old is not None and \
+                    old.data.type == T.LedgerEntryType.ACCOUNT:
+                old_bal = old.data.value.balance
+            delta += new_bal - old_bal
+        hdr_new = ltx.header()
+        hdr_old = ltx.parent.header()
+        delta += hdr_new.feePool - hdr_old.feePool
+        delta -= hdr_new.totalCoins - hdr_old.totalCoins
+        if delta != 0:
+            return f"lumens not conserved: delta {delta}"
+        return ""
+
+
+ALL_INVARIANTS = [LedgerEntryIsValid, ConservationOfLumens]
+
+
+class InvariantManager:
+    def __init__(self, patterns: List[str] = ()):
+        self.invariants: List[Invariant] = []
+        for cls in ALL_INVARIANTS:
+            if any(re.fullmatch(p, cls.NAME) for p in patterns):
+                self.invariants.append(cls())
+
+    def check_on_tx_apply(self, ltx, frame, ok: bool) -> None:
+        for inv in self.invariants:
+            msg = inv.check_on_tx_apply(ltx, frame, ok)
+            if msg:
+                raise InvariantDoesNotHold(f"{inv.NAME}: {msg}")

@@ -1,0 +1,271 @@
+"""LedgerManager: the replicated-state-machine "apply" side
+(ref src/ledger/LedgerManagerImpl.cpp — SURVEY.md §2.4).
+
+``close_ledger`` follows the reference's step order (closeLedger :669-933):
+apply-order sort -> fee phase (processFeesSeqNums) -> apply phase
+(applyTransactions) -> upgrades -> header seal -> bucket list add ->
+history/meta emission -> SQL commit.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..crypto import SecretKey, sha256
+from ..xdr import types as T, xdr_sha256
+from .ledger_txn import LedgerTxn, LedgerTxnRoot, open_database
+
+GENESIS_LEDGER_SEQ = 1
+
+
+class LedgerCloseData:
+    """(ledgerSeq, TxSetFrame, StellarValue) bundle handed from Herder
+    (ref src/herder/LedgerCloseData.h:23)."""
+
+    def __init__(self, ledger_seq: int, tx_set, close_value):
+        self.ledger_seq = ledger_seq
+        self.tx_set = tx_set
+        self.close_value = close_value  # XDR StellarValue
+
+
+class LedgerManager:
+    def __init__(self, app):
+        self.app = app
+        self.root = LedgerTxnRoot(app.database)
+        self._lcl_hash: Optional[bytes] = None
+        self.metrics = app.metrics
+
+    # -- genesis / load ----------------------------------------------------
+
+    def start_new_ledger(self) -> None:
+        """Create the genesis ledger: root account holds all lumens; root
+        secret seed = network id (ref LedgerManagerImpl::startNewLedger,
+        GENESIS_* constants)."""
+        cfg = self.app.config
+        root_sk = SecretKey(cfg.network_id())
+        total = 10**11 * 10**7  # 100B lumens in stroops
+        sv = T.StellarValue.make(
+            txSetHash=b"\x00" * 32,
+            closeTime=0,
+            upgrades=[],
+            ext=T.StellarValue.fields[3][1].make(
+                T.StellarValueType.STELLAR_VALUE_BASIC))
+        header = T.LedgerHeader.make(
+            ledgerVersion=cfg.LEDGER_PROTOCOL_VERSION,
+            previousLedgerHash=b"\x00" * 32,
+            scpValue=sv,
+            txSetResultHash=b"\x00" * 32,
+            bucketListHash=b"\x00" * 32,
+            ledgerSeq=GENESIS_LEDGER_SEQ,
+            totalCoins=total,
+            feePool=0,
+            inflationSeq=0,
+            idPool=0,
+            baseFee=cfg.TESTING_UPGRADE_DESIRED_FEE,
+            baseReserve=cfg.TESTING_UPGRADE_RESERVE,
+            maxTxSetSize=cfg.TESTING_UPGRADE_MAX_TX_SET_SIZE,
+            skipList=[b"\x00" * 32] * 4,
+            ext=T.LedgerHeader.fields[14][1].make(0),
+        )
+        from ..transactions import utils as U
+
+        with LedgerTxn(self.root) as ltx:
+            ltx.set_header(header)
+            ltx.commit()
+        with LedgerTxn(self.root) as ltx:
+            ltx.put(U.make_account_entry(
+                root_sk.public_key().raw, total, seq_num=0))
+            ltx.commit()
+        self._lcl_hash = xdr_sha256(T.LedgerHeader, header)
+        self._store_lcl(header)
+
+    def load_last_known_ledger(self) -> bool:
+        try:
+            header = self.root.header()
+        except Exception:
+            return False
+        self._lcl_hash = xdr_sha256(T.LedgerHeader, header)
+        return True
+
+    # -- accessors ---------------------------------------------------------
+
+    def last_closed_header(self):
+        return self.root.header()
+
+    def last_closed_hash(self) -> bytes:
+        if self._lcl_hash is None:
+            self._lcl_hash = xdr_sha256(
+                T.LedgerHeader, self.root.header())
+        return self._lcl_hash
+
+    def last_closed_seq(self) -> int:
+        return self.root.header().ledgerSeq
+
+    def _store_lcl(self, header) -> None:
+        self.app.database.execute(
+            "INSERT INTO persistentstate(statename, state) "
+            "VALUES('lastclosedledger', ?) ON CONFLICT(statename) "
+            "DO UPDATE SET state=excluded.state",
+            (self._lcl_hash.hex(),))
+        self.app.database.commit()
+
+    # -- the close path ----------------------------------------------------
+
+    def close_ledger(self, close_data: LedgerCloseData) -> None:
+        """ref closeLedger :669-933."""
+        with self.metrics.timer("ledger.ledger.close").time_scope():
+            self._close_ledger_inner(close_data)
+
+    def _close_ledger_inner(self, close_data: LedgerCloseData) -> None:
+        prev_header = self.root.header()
+        if close_data.ledger_seq != prev_header.ledgerSeq + 1:
+            raise RuntimeError(
+                f"out-of-order close: got {close_data.ledger_seq}, "
+                f"lcl is {prev_header.ledgerSeq}")
+        tx_set = close_data.tx_set
+        if tx_set.previous_ledger_hash != self.last_closed_hash():
+            raise RuntimeError("tx set prev hash mismatch")
+        sv = close_data.close_value
+
+        with LedgerTxn(self.root) as ltx:
+            # open the new ledger: bump seq, set close-time scpValue
+            new_header = prev_header._replace(
+                ledgerSeq=close_data.ledger_seq,
+                previousLedgerHash=self.last_closed_hash(),
+                scpValue=sv,
+            )
+            ltx.set_header(new_header)
+
+            apply_order = tx_set.txs_in_apply_order()
+
+            # phase 0: batched signature verification on device (P5)
+            verdicts = tx_set.prevalidate_signatures(
+                use_device=self.app.config.CRYPTO_BACKEND == "tpu")
+            verify = tx_set.make_cached_verify(verdicts)
+
+            # phase 1: fees + seqnums for every tx, in apply order
+            # (ref processFeesSeqNums :1164)
+            fee_changes: List[object] = []
+            base_fee = prev_header.baseFee
+            with self.metrics.timer(
+                    "ledger.transaction.fee").time_scope():
+                for frame in apply_order:
+                    fee_changes.append(
+                        frame.process_fee_seq_num(ltx, base_fee))
+
+            # phase 2: apply transactions (ref applyTransactions :1297)
+            tx_result_metas: List[object] = []
+            result_pairs: List[object] = []
+            with self.metrics.timer(
+                    "ledger.transaction.apply").time_scope():
+                for i, frame in enumerate(apply_order):
+                    ok, result, meta = frame.apply(ltx, verify=verify)
+                    pair = frame.result_pair(result)
+                    result_pairs.append(pair)
+                    tx_result_metas.append(T.TransactionResultMeta.make(
+                        result=pair,
+                        feeProcessing=fee_changes[i],
+                        txApplyProcessing=meta))
+                    self.app.invariants.check_on_tx_apply(ltx, frame, ok)
+
+            # phase 3: upgrades (ref :786-830)
+            upgrade_metas: List[object] = []
+            header_now = ltx.header()
+            for raw in sv.upgrades:
+                upgrade = T.LedgerUpgrade.decode(raw)
+                with LedgerTxn(ltx) as ultx:
+                    hdr = self._apply_upgrade(ultx.header(), upgrade)
+                    ultx.set_header(hdr)
+                    changes = ultx.changes()
+                    ultx.commit()
+                upgrade_metas.append(T.UpgradeEntryMeta.make(
+                    upgrade=upgrade, changes=changes))
+
+            # phase 4: seal the header
+            result_set = T.TransactionResultSet.make(results=result_pairs)
+            tx_result_hash = xdr_sha256(T.TransactionResultSet, result_set)
+            sealed = ltx.header()._replace(
+                txSetResultHash=tx_result_hash,
+            )
+            ltx.set_header(sealed)
+
+            # phase 5: bucket list — state commitment
+            bucket_hash = self.app.bucket_manager.add_batch(
+                close_data.ledger_seq, self._collect_changes(ltx))
+            sealed = ltx.header()._replace(bucketListHash=bucket_hash)
+            sealed = self._update_skip_list(sealed)
+            ltx.set_header(sealed)
+
+            # phase 6: persist tx history rows (SQL, same commit)
+            self._store_tx_history(close_data.ledger_seq, apply_order,
+                                   tx_result_metas)
+            ltx.commit()
+
+        new_header = self.root.header()
+        self._lcl_hash = xdr_sha256(T.LedgerHeader, new_header)
+        self._store_lcl(new_header)
+        self.metrics.counter("ledger.ledger.count").set_count(
+            new_header.ledgerSeq)
+        # meta stream for downstream consumers
+        self.app.emit_ledger_close_meta(
+            new_header, tx_set, tx_result_metas, upgrade_metas)
+
+    def _collect_changes(self, ltx
+                         ) -> List[Tuple[bytes, Optional[object], bool]]:
+        """(key-bytes, entry-or-None, existed-before) list for the bucket
+        batch.  existed-before distinguishes true creations (INITENTRY,
+        whose deletion may annihilate) from updates of entries living in
+        deeper bucket levels (LIVEENTRY, whose deletion needs a persistent
+        tombstone) — the root still holds pre-close state here."""
+        return [
+            (kb, entry, self.root.get(kb) is not None)
+            for kb, entry in sorted(ltx._delta.items())
+        ]
+
+    def _apply_upgrade(self, header, upgrade):
+        UT = T.LedgerUpgradeType
+        if upgrade.type == UT.LEDGER_UPGRADE_VERSION:
+            return header._replace(ledgerVersion=upgrade.value)
+        if upgrade.type == UT.LEDGER_UPGRADE_BASE_FEE:
+            return header._replace(baseFee=upgrade.value)
+        if upgrade.type == UT.LEDGER_UPGRADE_MAX_TX_SET_SIZE:
+            return header._replace(maxTxSetSize=upgrade.value)
+        if upgrade.type == UT.LEDGER_UPGRADE_BASE_RESERVE:
+            return header._replace(baseReserve=upgrade.value)
+        if upgrade.type == UT.LEDGER_UPGRADE_FLAGS:
+            ext = T.LedgerHeader.fields[14][1].make(
+                1, T.LedgerHeaderExtensionV1.make(
+                    flags=upgrade.value,
+                    ext=T.LedgerHeaderExtensionV1.fields[1][1].make(0)))
+            return header._replace(ext=ext)
+        return header
+
+    SKIP_1, SKIP_2, SKIP_3, SKIP_4 = 50, 5000, 50000, 500000
+
+    def _update_skip_list(self, header):
+        """Cascaded skip-list rotation keyed on the NEW header's seq
+        (ref BucketManagerImpl::calculateSkipValues)."""
+        seq = header.ledgerSeq
+        sl = list(header.skipList)
+        if seq % self.SKIP_1 == 0:
+            v = seq - self.SKIP_1
+            if v > 0 and v % self.SKIP_2 == 0:
+                v = seq - self.SKIP_2 - self.SKIP_1
+                if v > 0 and v % self.SKIP_3 == 0:
+                    v = seq - self.SKIP_3 - self.SKIP_2 - self.SKIP_1
+                    if v > 0 and v % self.SKIP_4 == 0:
+                        sl[3] = sl[2]
+                    sl[2] = sl[1]
+                sl[1] = sl[0]
+            sl[0] = header.bucketListHash
+        return header._replace(skipList=sl)
+
+    def _store_tx_history(self, seq: int, frames, metas) -> None:
+        cur = self.app.database.cursor()
+        for i, (frame, meta) in enumerate(zip(frames, metas)):
+            cur.execute(
+                "INSERT INTO txhistory(txid, ledgerseq, txindex, txbody, "
+                "txresult, txmeta) VALUES(?,?,?,?,?,?)",
+                (frame.full_hash(), seq, i,
+                 T.TransactionEnvelope.encode(frame.envelope),
+                 T.TransactionResultPair.encode(meta.result),
+                 T.TransactionMeta.encode(meta.txApplyProcessing)))

@@ -1,0 +1,425 @@
+"""LedgerTxn: nested in-memory transaction layers over ledger entries with
+commit/rollback; the root commits to SQLite.
+
+Design (re-derived from the reference's 70-line design essay at
+src/ledger/LedgerTxn.h:22-100, simplified to a functional copy-on-write
+model instead of the reference's entry-activation machinery):
+
+- Keys are canonical XDR-encoded ``LedgerKey`` bytes.
+- A layer holds a delta: key -> LedgerEntry-value | None (None = erased).
+- Reads fall through to the parent; writes stay in the layer until commit.
+- ``changes()`` produces LedgerEntryChanges (STATE+UPDATED/CREATED/REMOVED)
+  for meta streams, matching the reference's semantics of emitting the
+  previous STATE before each change (ref LedgerTxn::getChanges).
+- At most one open child per layer (enforced, like the reference).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..xdr import types as T
+
+
+class LedgerTxnError(Exception):
+    pass
+
+
+def entry_to_key(entry) -> object:
+    """LedgerEntry -> LedgerKey value."""
+    d = entry.data
+    t = d.type
+    LE = T.LedgerEntryType
+    if t == LE.ACCOUNT:
+        arm = T.LedgerKey.arms[t][1].make(accountID=d.value.accountID)
+    elif t == LE.TRUSTLINE:
+        arm = T.LedgerKey.arms[t][1].make(
+            accountID=d.value.accountID, asset=d.value.asset)
+    elif t == LE.OFFER:
+        arm = T.LedgerKey.arms[t][1].make(
+            sellerID=d.value.sellerID, offerID=d.value.offerID)
+    elif t == LE.DATA:
+        arm = T.LedgerKey.arms[t][1].make(
+            accountID=d.value.accountID, dataName=d.value.dataName)
+    elif t == LE.CLAIMABLE_BALANCE:
+        arm = T.LedgerKey.arms[t][1].make(balanceID=d.value.balanceID)
+    elif t == LE.LIQUIDITY_POOL:
+        arm = T.LedgerKey.arms[t][1].make(
+            liquidityPoolID=d.value.liquidityPoolID)
+    else:
+        raise LedgerTxnError(f"unknown entry type {t}")
+    return T.LedgerKey.make(t, arm)
+
+
+def key_bytes(key) -> bytes:
+    return T.LedgerKey.encode(key)
+
+
+class AbstractLedgerTxn:
+    """Shared read/write surface of LedgerTxn and LedgerTxnRoot."""
+
+    def get(self, kb: bytes):
+        raise NotImplementedError
+
+    def header(self):
+        raise NotImplementedError
+
+    # -- typed convenience loads (the TransactionUtils seam) ---------------
+
+    def load(self, key) -> Optional[object]:
+        return self.get(key_bytes(key))
+
+    def load_account(self, account_id: bytes):
+        k = T.LedgerKey.make(
+            T.LedgerEntryType.ACCOUNT,
+            T.LedgerKey.arms[T.LedgerEntryType.ACCOUNT][1].make(
+                accountID=T.account_id(account_id)))
+        return self.load(k)
+
+    def load_trustline(self, account_id: bytes, asset):
+        k = T.LedgerKey.make(
+            T.LedgerEntryType.TRUSTLINE,
+            T.LedgerKey.arms[T.LedgerEntryType.TRUSTLINE][1].make(
+                accountID=T.account_id(account_id), asset=asset))
+        return self.load(k)
+
+    def load_offer(self, seller_id: bytes, offer_id: int):
+        k = T.LedgerKey.make(
+            T.LedgerEntryType.OFFER,
+            T.LedgerKey.arms[T.LedgerEntryType.OFFER][1].make(
+                sellerID=T.account_id(seller_id), offerID=offer_id))
+        return self.load(k)
+
+    def load_data(self, account_id: bytes, name: bytes):
+        k = T.LedgerKey.make(
+            T.LedgerEntryType.DATA,
+            T.LedgerKey.arms[T.LedgerEntryType.DATA][1].make(
+                accountID=T.account_id(account_id), dataName=name))
+        return self.load(k)
+
+
+class LedgerTxn(AbstractLedgerTxn):
+    def __init__(self, parent: AbstractLedgerTxn):
+        self.parent = parent
+        if isinstance(parent, (LedgerTxn, LedgerTxnRoot)):
+            if parent._child is not None:
+                raise LedgerTxnError("parent already has an open child")
+            parent._child = self
+        self._delta: Dict[bytes, Optional[object]] = {}
+        self._header = None  # modified header, if any
+        self._child: Optional["LedgerTxn"] = None
+        self._open = True
+
+    # -- reads -------------------------------------------------------------
+
+    def _check_open(self):
+        """Write/commit guard: must be open AND innermost (no open child).
+        Reads only require being open — a child's fall-through read reaches
+        the parent while the child is the parent's open child."""
+        if not self._open:
+            raise LedgerTxnError("ledger txn is closed")
+        if self._child is not None:
+            raise LedgerTxnError("ledger txn has an open child")
+
+    def get(self, kb: bytes):
+        if not self._open:
+            raise LedgerTxnError("ledger txn is closed")
+        if kb in self._delta:
+            return self._delta[kb]
+        return self.parent.get(kb)
+
+    def header(self):
+        if not self._open:
+            raise LedgerTxnError("ledger txn is closed")
+        if self._header is not None:
+            return self._header
+        return self.parent.header()
+
+    def set_header(self, header) -> None:
+        self._check_open()
+        self._header = header
+
+    # -- writes ------------------------------------------------------------
+
+    def put(self, entry) -> None:
+        """Create or update; stamps lastModifiedLedgerSeq with the current
+        (open) ledger seq like the reference does on commit."""
+        self._check_open()
+        entry = entry._replace(
+            lastModifiedLedgerSeq=self.header().ledgerSeq)
+        self._delta[key_bytes(entry_to_key(entry))] = entry
+
+    def erase(self, key) -> None:
+        self._check_open()
+        kb = key_bytes(key)
+        if self.get(kb) is None:
+            raise LedgerTxnError("erasing nonexistent entry")
+        self._delta[kb] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def commit(self) -> None:
+        self._check_open()
+        if isinstance(self.parent, LedgerTxnRoot):
+            self.parent._commit_from_child(self._delta, self._header)
+        else:
+            self.parent._delta.update(self._delta)
+            if self._header is not None:
+                self.parent._header = self._header
+        self._close()
+
+    def rollback(self) -> None:
+        if not self._open:
+            raise LedgerTxnError("ledger txn is closed")
+        if self._child is not None:
+            self._child.rollback()
+        self._close()
+
+    def _close(self) -> None:
+        self._open = False
+        if isinstance(self.parent, (LedgerTxn, LedgerTxnRoot)):
+            self.parent._child = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._open:
+            if exc_type is None:
+                self.commit()
+            else:
+                self.rollback()
+        return False
+
+    # -- meta --------------------------------------------------------------
+
+    def changes(self) -> List[object]:
+        """LedgerEntryChanges for the delta of THIS layer: STATE (previous
+        value from the parent) + UPDATED / CREATED / REMOVED."""
+        self._check_open()
+        out = []
+        CT = T.LedgerEntryChangeType
+        for kb, new in sorted(self._delta.items()):
+            old = self.parent.get(kb)
+            if old is not None:
+                out.append(T.LedgerEntryChange.make(
+                    CT.LEDGER_ENTRY_STATE, old))
+                if new is None:
+                    out.append(T.LedgerEntryChange.make(
+                        CT.LEDGER_ENTRY_REMOVED, T.LedgerKey.decode(kb)))
+                else:
+                    out.append(T.LedgerEntryChange.make(
+                        CT.LEDGER_ENTRY_UPDATED, new))
+            else:
+                if new is None:
+                    continue  # created+erased inside this layer: no-op
+                out.append(T.LedgerEntryChange.make(
+                    CT.LEDGER_ENTRY_CREATED, new))
+        return out
+
+    # -- queries needing parent cooperation --------------------------------
+
+    def best_offer(self, selling_bytes: bytes, buying_bytes: bytes,
+                   worse_than=None):
+        """Best (lowest price, then oldest) offer for the asset pair,
+        taking this txn's uncommitted delta into account.
+
+        selling/buying are canonical XDR Asset encodings."""
+        self._check_open()
+        # collect delta offers (and deletions) up the chain
+        overrides: Dict[bytes, Optional[object]] = {}
+        layer = self
+        while isinstance(layer, LedgerTxn):
+            for kb, e in layer._delta.items():
+                if kb not in overrides and kb.startswith(_OFFER_PREFIX):
+                    overrides[kb] = e
+            layer = layer.parent
+        root: LedgerTxnRoot = layer
+        return root._best_offer(
+            selling_bytes, buying_bytes, overrides, worse_than)
+
+    def header_ledger_seq(self) -> int:
+        return self.header().ledgerSeq
+
+
+_OFFER_PREFIX = T.LedgerEntryType.encode(T.LedgerEntryType.OFFER)
+
+
+def _offer_order_key(entry) -> Tuple[float, int]:
+    o = entry.data.value
+    return (o.price.n / o.price.d, o.offerID)
+
+
+class LedgerTxnRoot(AbstractLedgerTxn):
+    """Root layer: SQLite-backed entry store + header (ref LedgerTxnRoot
+    with the per-type SQL adapters collapsed into a keyed store + an offers
+    index for order-book scans — SURVEY.md §2.4/§2.11)."""
+
+    def __init__(self, db):
+        self.db = db
+        self._child: Optional[LedgerTxn] = None
+        self._header_cache = None
+
+    # -- reads -------------------------------------------------------------
+
+    def get(self, kb: bytes):
+        row = self.db.execute(
+            "SELECT entry FROM ledgerentries WHERE key = ?", (kb,)
+        ).fetchone()
+        if row is None:
+            return None
+        return T.LedgerEntry.decode(row[0])
+
+    def header(self):
+        if self._header_cache is None:
+            row = self.db.execute(
+                "SELECT data FROM ledgerheaders "
+                "ORDER BY ledgerseq DESC LIMIT 1").fetchone()
+            if row is None:
+                raise LedgerTxnError("no ledger header")
+            self._header_cache = T.LedgerHeader.decode(row[0])
+        return self._header_cache
+
+    # -- commit ------------------------------------------------------------
+
+    def _commit_from_child(self, delta: Dict[bytes, Optional[object]],
+                           header) -> None:
+        cur = self.db.cursor()
+        for kb, entry in delta.items():
+            if entry is None:
+                cur.execute("DELETE FROM ledgerentries WHERE key = ?", (kb,))
+                cur.execute("DELETE FROM offers WHERE key = ?", (kb,))
+            else:
+                eb = T.LedgerEntry.encode(entry)
+                et = entry.data.type
+                cur.execute(
+                    "INSERT INTO ledgerentries(key, type, entry) "
+                    "VALUES(?,?,?) ON CONFLICT(key) DO UPDATE SET "
+                    "entry=excluded.entry",
+                    (kb, et, eb))
+                if et == T.LedgerEntryType.OFFER:
+                    o = entry.data.value
+                    cur.execute(
+                        "INSERT INTO offers(key, sellerid, offerid, "
+                        "selling, buying, price, pricen, priced, amount) "
+                        "VALUES(?,?,?,?,?,?,?,?,?) ON CONFLICT(key) DO "
+                        "UPDATE SET selling=excluded.selling, "
+                        "buying=excluded.buying, price=excluded.price, "
+                        "pricen=excluded.pricen, priced=excluded.priced, "
+                        "amount=excluded.amount",
+                        (kb, o.sellerID.value, o.offerID,
+                         T.Asset.encode(o.selling), T.Asset.encode(o.buying),
+                         o.price.n / o.price.d, o.price.n, o.price.d,
+                         o.amount))
+        if header is not None:
+            hb = T.LedgerHeader.encode(header)
+            cur.execute(
+                "INSERT INTO ledgerheaders(ledgerseq, data) VALUES(?,?) "
+                "ON CONFLICT(ledgerseq) DO UPDATE SET data=excluded.data",
+                (header.ledgerSeq, hb))
+            self._header_cache = header
+        self.db.commit()
+
+    # -- order-book scan ---------------------------------------------------
+
+    def _best_offer(self, selling: bytes, buying: bytes,
+                    overrides: Dict[bytes, Optional[object]],
+                    worse_than=None):
+        """Lowest-price offer for the pair, merging the SQL index with the
+        uncommitted overrides.  worse_than: (price_float, offerID) exclusive
+        lower bound for iteration."""
+        candidates = []
+        q = ("SELECT key, pricen, priced, offerid FROM offers "
+             "WHERE selling = ? AND buying = ? ORDER BY price, offerid")
+        for kb, pn, pd, oid in self.db.execute(q, (selling, buying)):
+            if kb in overrides:
+                continue  # shadowed by the open txn
+            if worse_than is not None and (pn / pd, oid) <= worse_than:
+                continue
+            candidates.append((pn / pd, oid, kb))
+            break  # SQL rows are sorted; first unshadowed row wins
+        for kb, e in overrides.items():
+            if e is None:
+                continue
+            o = e.data.value
+            if (T.Asset.encode(o.selling) != selling
+                    or T.Asset.encode(o.buying) != buying):
+                continue
+            key = (o.price.n / o.price.d, o.offerID)
+            if worse_than is not None and key <= worse_than:
+                continue
+            candidates.append((*key, kb))
+        if not candidates:
+            return None
+        candidates.sort()
+        kb = candidates[0][2]
+        e = overrides.get(kb)
+        if e is None:
+            e = self.get(kb)
+        return e
+
+    def count_entries(self) -> int:
+        return self.db.execute(
+            "SELECT COUNT(*) FROM ledgerentries").fetchone()[0]
+
+    def all_entries(self) -> Iterable[object]:
+        for (blob,) in self.db.execute(
+                "SELECT entry FROM ledgerentries ORDER BY key"):
+            yield T.LedgerEntry.decode(blob)
+
+
+SCHEMA = """
+CREATE TABLE IF NOT EXISTS ledgerentries (
+    key BLOB PRIMARY KEY,
+    type INTEGER NOT NULL,
+    entry BLOB NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_entries_type ON ledgerentries(type);
+CREATE TABLE IF NOT EXISTS offers (
+    key BLOB PRIMARY KEY,
+    sellerid BLOB NOT NULL,
+    offerid INTEGER NOT NULL,
+    selling BLOB NOT NULL,
+    buying BLOB NOT NULL,
+    price REAL NOT NULL,
+    pricen INTEGER NOT NULL,
+    priced INTEGER NOT NULL,
+    amount INTEGER NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_offers_book
+    ON offers(selling, buying, price, offerid);
+CREATE TABLE IF NOT EXISTS ledgerheaders (
+    ledgerseq INTEGER PRIMARY KEY,
+    data BLOB NOT NULL
+);
+CREATE TABLE IF NOT EXISTS persistentstate (
+    statename TEXT PRIMARY KEY,
+    state TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS txhistory (
+    txid BLOB NOT NULL,
+    ledgerseq INTEGER NOT NULL,
+    txindex INTEGER NOT NULL,
+    txbody BLOB NOT NULL,
+    txresult BLOB NOT NULL,
+    txmeta BLOB NOT NULL,
+    PRIMARY KEY (ledgerseq, txindex)
+);
+CREATE TABLE IF NOT EXISTS scphistory (
+    nodeid BLOB NOT NULL,
+    ledgerseq INTEGER NOT NULL,
+    envelope BLOB NOT NULL
+);
+CREATE TABLE IF NOT EXISTS scpquorums (
+    qsethash BLOB PRIMARY KEY,
+    lastledgerseq INTEGER NOT NULL,
+    qset BLOB NOT NULL
+);
+"""
+
+
+def open_database(path: str = ":memory:"):
+    import sqlite3
+
+    db = sqlite3.connect(path)
+    db.executescript(SCHEMA)
+    return db

@@ -1,0 +1,153 @@
+"""Application: the container that owns and wires every subsystem
+(ref src/main/Application.h:132-318, ApplicationImpl.cpp — SURVEY.md §2.10).
+
+Construction wires: clock -> metrics -> database -> bucket manager ->
+ledger manager -> invariants -> herder -> (overlay, history when
+configured).  ``start()`` mirrors ApplicationImpl::start() :772-821:
+load-or-create ledger -> herder start -> overlay start.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..bucket.bucket_list import BucketManager
+from ..herder.herder import Herder
+from ..invariant.manager import InvariantManager
+from ..ledger.ledger_manager import LedgerManager
+from ..ledger.ledger_txn import open_database
+from ..utils.clock import ClockMode, VirtualClock
+from ..utils.metrics import MetricsRegistry
+from ..utils.scheduler import Scheduler
+from ..work.work import WorkScheduler
+from ..xdr import types as T
+from .config import Config
+
+
+class Application:
+    def __init__(self, clock: VirtualClock, config: Config):
+        self.clock = clock
+        self.config = config
+        self.metrics = MetricsRegistry(clock)
+        self.scheduler = Scheduler(clock)
+        self.database = open_database(config.DATABASE)
+        self.bucket_manager = BucketManager(self)
+        self.invariants = InvariantManager(config.INVARIANT_CHECKS)
+        self.ledger_manager = LedgerManager(self)
+        self.work_scheduler = WorkScheduler(clock)
+        self.herder = Herder(self)
+        self.overlay_manager = None   # wired by overlay.setup (optional)
+        self.catchup_manager = _BufferingCatchup(self)
+        self.history_manager = None
+        self._meta_stream: List = []
+        self._started = False
+
+    # -- lifecycle (ref ApplicationImpl::start :772) ------------------------
+
+    @classmethod
+    def create(cls, clock: Optional[VirtualClock] = None,
+               config: Optional[Config] = None) -> "Application":
+        return cls(clock or VirtualClock(ClockMode.REAL_TIME),
+                   config or Config())
+
+    def start(self) -> None:
+        if not self.ledger_manager.load_last_known_ledger():
+            self.ledger_manager.start_new_ledger()
+        self.herder.start()
+        if self.overlay_manager is not None:
+            self.overlay_manager.start()
+        self._started = True
+
+    def crank(self, block: bool = False) -> int:
+        n = self.clock.crank(block)
+        while self.scheduler.run_one():
+            n += 1
+        self.work_scheduler.crank()
+        return n
+
+    def graceful_stop(self) -> None:
+        if self.overlay_manager is not None:
+            self.overlay_manager.shutdown()
+        self.clock.stop()
+
+    # -- cross-subsystem plumbing ------------------------------------------
+
+    def broadcast_transaction(self, env) -> None:
+        if self.overlay_manager is not None:
+            self.overlay_manager.broadcast_transaction(env)
+
+    def broadcast_scp_message(self, env) -> None:
+        if self.overlay_manager is not None:
+            self.overlay_manager.broadcast_scp(env)
+
+    def request_scp_items(self, hashes: List[bytes]) -> None:
+        if self.overlay_manager is not None:
+            self.overlay_manager.fetch_items(hashes)
+
+    def emit_ledger_close_meta(self, header, tx_set, tx_metas,
+                               upgrade_metas) -> None:
+        """METADATA_OUTPUT_STREAM equivalent: in-memory ring of
+        LedgerCloseMeta (ref LedgerManagerImpl.cpp:738-757)."""
+        from ..xdr import xdr_sha256
+
+        meta = T.LedgerCloseMeta.make(0, T.LedgerCloseMetaV0.make(
+            ledgerHeader=T.LedgerHeaderHistoryEntry.make(
+                hash=xdr_sha256(T.LedgerHeader, header),
+                header=header,
+                ext=T.LedgerHeaderHistoryEntry.fields[2][1].make(0)),
+            txSet=tx_set.to_xdr(),
+            txProcessing=tx_metas,
+            upgradesProcessing=upgrade_metas,
+            scpInfo=[]))
+        self._meta_stream.append(meta)
+        if len(self._meta_stream) > 64:
+            self._meta_stream.pop(0)
+
+    # -- status (ref getJsonInfo / 'info' endpoint) -------------------------
+
+    def get_json_info(self) -> dict:
+        lm = self.ledger_manager
+        try:
+            header = lm.last_closed_header()
+            ledger_info = {
+                "num": header.ledgerSeq,
+                "hash": lm.last_closed_hash().hex(),
+                "closeTime": header.scpValue.closeTime,
+                "baseFee": header.baseFee,
+                "baseReserve": header.baseReserve,
+                "maxTxSetSize": header.maxTxSetSize,
+                "version": header.ledgerVersion,
+            }
+        except Exception:
+            ledger_info = {}
+        return {
+            "build": "stellar-core-tpu",
+            "ledger": ledger_info,
+            "state": ("Synced!" if self._started else "Booting"),
+            "network": self.config.NETWORK_PASSPHRASE,
+            "protocol_version": self.config.LEDGER_PROTOCOL_VERSION,
+            "peers": (self.overlay_manager.connection_count()
+                      if self.overlay_manager else 0),
+            "pending_txs": self.herder.tx_queue.size(),
+            "crypto_backend": self.config.CRYPTO_BACKEND,
+        }
+
+
+class _BufferingCatchup:
+    """Minimal CatchupManager stand-in: buffers out-of-order externalized
+    ledgers and replays them when contiguous (full archive-based catchup
+    lands with the history subsystem)."""
+
+    def __init__(self, app):
+        self.app = app
+        self.buffered = {}
+
+    def buffer_externalized(self, seq, tx_set, sv) -> None:
+        from ..ledger.ledger_manager import LedgerCloseData
+
+        self.buffered[seq] = (tx_set, sv)
+        lm = self.app.ledger_manager
+        while lm.last_closed_seq() + 1 in self.buffered:
+            s = lm.last_closed_seq() + 1
+            ts, value = self.buffered.pop(s)
+            lm.close_ledger(LedgerCloseData(s, ts, value))
+            self.app.herder.ledger_closed(s)

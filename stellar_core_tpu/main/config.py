@@ -1,0 +1,113 @@
+"""Config: the node's knob surface (ref src/main/Config.h — a 607-line
+header of ~200 TOML-loaded fields; this port keeps the same names for the
+load-bearing ones and loads from TOML via tomllib or from kwargs).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..crypto import SecretKey, sha256
+
+
+class Config:
+    CURRENT_LEDGER_PROTOCOL_VERSION = 19
+
+    def __init__(self, **kw):
+        # identity / network
+        self.NETWORK_PASSPHRASE: str = kw.get(
+            "NETWORK_PASSPHRASE", "Test SDF Network ; September 2015")
+        self.NODE_SEED: Optional[bytes] = kw.get("NODE_SEED")
+        self.NODE_IS_VALIDATOR: bool = kw.get("NODE_IS_VALIDATOR", True)
+        self.QUORUM_SET: Optional[dict] = kw.get("QUORUM_SET")
+
+        # mode
+        self.RUN_STANDALONE: bool = kw.get("RUN_STANDALONE", False)
+        self.MANUAL_CLOSE: bool = kw.get("MANUAL_CLOSE", False)
+        self.FORCE_SCP: bool = kw.get("FORCE_SCP", False)
+
+        # protocol / testing knobs
+        self.LEDGER_PROTOCOL_VERSION: int = kw.get(
+            "LEDGER_PROTOCOL_VERSION",
+            self.CURRENT_LEDGER_PROTOCOL_VERSION)
+        self.TESTING_UPGRADE_DESIRED_FEE: int = kw.get(
+            "TESTING_UPGRADE_DESIRED_FEE", 100)
+        self.TESTING_UPGRADE_RESERVE: int = kw.get(
+            "TESTING_UPGRADE_RESERVE", 5000000)
+        self.TESTING_UPGRADE_MAX_TX_SET_SIZE: int = kw.get(
+            "TESTING_UPGRADE_MAX_TX_SET_SIZE", 100)
+        self.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING: bool = kw.get(
+            "ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING", False)
+
+        # storage
+        self.DATABASE: str = kw.get("DATABASE", ":memory:")
+        self.BUCKET_DIR_PATH: str = kw.get("BUCKET_DIR_PATH", "buckets")
+
+        # consensus cadence (ref Herder.cpp:7-18)
+        self.EXP_LEDGER_TIMESPAN_SECONDS: float = kw.get(
+            "EXP_LEDGER_TIMESPAN_SECONDS",
+            1.0 if kw.get("ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING")
+            else 5.0)
+        self.MAX_SCP_TIMEOUT_SECONDS: float = 240.0
+        self.CONSENSUS_STUCK_TIMEOUT_SECONDS: float = 35.0
+
+        # overlay
+        self.PEER_PORT: int = kw.get("PEER_PORT", 11625)
+        self.HTTP_PORT: int = kw.get("HTTP_PORT", 11626)
+        self.TARGET_PEER_CONNECTIONS: int = kw.get(
+            "TARGET_PEER_CONNECTIONS", 8)
+        self.MAX_ADDITIONAL_PEER_CONNECTIONS: int = kw.get(
+            "MAX_ADDITIONAL_PEER_CONNECTIONS", 64)
+        self.KNOWN_PEERS: List[str] = kw.get("KNOWN_PEERS", [])
+
+        # device tier
+        self.CRYPTO_BACKEND: str = kw.get("CRYPTO_BACKEND", "cpu")
+
+        # invariants
+        self.INVARIANT_CHECKS: List[str] = kw.get("INVARIANT_CHECKS", [])
+
+        # history
+        self.HISTORY: Dict[str, dict] = kw.get("HISTORY", {})
+        self.CHECKPOINT_FREQUENCY: int = (
+            8 if self.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING else 64)
+
+        if self.NODE_SEED is None:
+            self.NODE_SEED = sha256(b"default-node-seed")
+
+    def network_id(self) -> bytes:
+        return sha256(self.NETWORK_PASSPHRASE.encode())
+
+    def node_secret(self) -> SecretKey:
+        return SecretKey(self.NODE_SEED)
+
+    def node_id(self) -> bytes:
+        return self.node_secret().public_key().raw
+
+    @classmethod
+    def from_toml(cls, path: str) -> "Config":
+        import tomllib
+
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+        kw = {}
+        for k, v in data.items():
+            kw[k.upper()] = v
+        if "NODE_SEED" in kw and isinstance(kw["NODE_SEED"], str):
+            from ..crypto.strkey import decode_ed25519_seed
+
+            kw["NODE_SEED"] = decode_ed25519_seed(kw["NODE_SEED"])
+        return cls(**kw)
+
+
+def test_config(n: int = 0, **kw) -> Config:
+    """getTestConfig equivalent (ref src/test/TestUtils): standalone,
+    manual close, in-memory DB, accelerated time."""
+    defaults = dict(
+        NODE_SEED=sha256(b"test-node-%d" % n),
+        RUN_STANDALONE=True,
+        MANUAL_CLOSE=True,
+        ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING=True,
+        DATABASE=":memory:",
+        INVARIANT_CHECKS=[".*"],
+    )
+    defaults.update(kw)
+    return Config(**defaults)

@@ -1,0 +1,407 @@
+"""TransactionFrame: hashing, fees, the validity-check chain, sequence
+numbers, signature gathering, and the all-or-nothing apply loop over
+operations (ref src/transactions/TransactionFrame.cpp — SURVEY.md §2.5).
+
+The north-star hot path lives here: checkValid -> commonValid ->
+processSignatures -> SignatureChecker.checkSignature -> crypto verify
+(ref TransactionFrame.cpp:1339, SecretKey.cpp:428).  The verify callable is
+pluggable so the Herder can pre-verify whole TxSets with the batched TPU
+kernel and feed cached verdicts here (the --crypto-backend seam).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+from ..crypto import sha256
+from ..ledger.ledger_txn import LedgerTxn
+from ..xdr import types as T
+from . import utils as U
+from .operations import make_operation_frame
+from .signature_checker import SignatureChecker, account_signers
+
+TC = T.TransactionResultCode
+
+
+class ValidationResult:
+    def __init__(self, code: int, fee_charged: int = 0):
+        self.code = code
+        self.fee_charged = fee_charged
+
+    @property
+    def ok(self) -> bool:
+        return self.code == TC.txSUCCESS
+
+
+class TransactionFrame:
+    def __init__(self, network_id: bytes, envelope):
+        if envelope.type == T.EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP:
+            raise ValueError("use FeeBumpTransactionFrame")
+        self.network_id = network_id
+        self.envelope = envelope
+        if envelope.type == T.EnvelopeType.ENVELOPE_TYPE_TX_V0:
+            v0tx = envelope.value.tx
+            # normalize v0 -> v1 view (ref: TransactionV0 parsed as v1)
+            self.tx = T.Transaction.make(
+                sourceAccount=T.MuxedAccount.make(
+                    T.CryptoKeyType.KEY_TYPE_ED25519,
+                    v0tx.sourceAccountEd25519),
+                fee=v0tx.fee,
+                seqNum=v0tx.seqNum,
+                cond=(T.Preconditions.make(T.PreconditionType.PRECOND_NONE)
+                      if v0tx.timeBounds is None else
+                      T.Preconditions.make(T.PreconditionType.PRECOND_TIME,
+                                           v0tx.timeBounds)),
+                memo=v0tx.memo,
+                operations=v0tx.operations,
+                ext=T.Transaction.fields[6][1].make(0),
+            )
+        else:
+            self.tx = envelope.value.tx
+        self.signatures = list(envelope.value.signatures)
+        self._hash: Optional[bytes] = None
+        self.op_frames = [
+            make_operation_frame(op, self) for op in self.tx.operations]
+        self.result_code: int = TC.txSUCCESS
+        self.fee_charged: int = 0
+
+    # -- identity ----------------------------------------------------------
+
+    def source_account_id(self) -> bytes:
+        return U.muxed_to_account_id(self.tx.sourceAccount)
+
+    def seq_num(self) -> int:
+        return self.tx.seqNum
+
+    def full_hash(self) -> bytes:
+        """sha256 of the TransactionSignaturePayload — what gets signed AND
+        the tx id (ref TransactionFrame::getContentsHash)."""
+        if self._hash is None:
+            payload = T.TransactionSignaturePayload.make(
+                networkId=self.network_id,
+                taggedTransaction=T.TransactionSignaturePayload.fields[1][1]
+                .make(T.EnvelopeType.ENVELOPE_TYPE_TX, self.tx),
+            )
+            self._hash = sha256(
+                T.TransactionSignaturePayload.encode(payload))
+        return self._hash
+
+    def num_operations(self) -> int:
+        return len(self.tx.operations)
+
+    # -- preconditions -----------------------------------------------------
+
+    def _time_bounds(self):
+        c = self.tx.cond
+        if c.type == T.PreconditionType.PRECOND_TIME:
+            return c.value
+        if c.type == T.PreconditionType.PRECOND_V2:
+            return c.value.timeBounds
+        return None
+
+    def _ledger_bounds(self):
+        c = self.tx.cond
+        if c.type == T.PreconditionType.PRECOND_V2:
+            return c.value.ledgerBounds
+        return None
+
+    def _v2(self):
+        c = self.tx.cond
+        return c.value if c.type == T.PreconditionType.PRECOND_V2 else None
+
+    def is_too_early(self, header, lower_bound_close_time_offset=0) -> bool:
+        tb = self._time_bounds()
+        if tb is not None and tb.minTime:
+            close_time = header.scpValue.closeTime
+            if close_time + lower_bound_close_time_offset < tb.minTime:
+                return True
+        lb = self._ledger_bounds()
+        if lb is not None and header.ledgerSeq + 1 < lb.minLedger:
+            return True
+        return False
+
+    def is_too_late(self, header, upper_bound_close_time_offset=0) -> bool:
+        tb = self._time_bounds()
+        if tb is not None and tb.maxTime:
+            close_time = header.scpValue.closeTime
+            if close_time - upper_bound_close_time_offset > tb.maxTime:
+                return True
+        lb = self._ledger_bounds()
+        if lb is not None and lb.maxLedger and \
+                header.ledgerSeq + 1 >= lb.maxLedger:
+            return True
+        return False
+
+    # -- fees --------------------------------------------------------------
+
+    def get_full_fee(self) -> int:
+        return self.tx.fee
+
+    def get_inclusion_fee(self) -> int:
+        return self.tx.fee
+
+    def get_min_fee(self, header) -> int:
+        return max(1, self.num_operations()) * header.baseFee
+
+    def fee_bid(self) -> int:
+        return self.tx.fee
+
+    # -- the validity chain ------------------------------------------------
+
+    def common_valid_pre_seqnum(self, ltx, charge_fee: bool,
+                                current: bool = False) -> int:
+        """ref commonValidPreSeqNum (TransactionFrame.cpp:849)."""
+        header = ltx.header()
+        if not self.tx.operations:
+            return TC.txMISSING_OPERATION
+        if len(self.tx.operations) > U.TX_MAX_OPS:
+            return TC.txMALFORMED
+        tb = self._time_bounds()
+        if tb is not None and tb.maxTime and tb.minTime > tb.maxTime:
+            return TC.txMALFORMED
+        v2 = self._v2()
+        if v2 is not None:
+            lb = v2.ledgerBounds
+            if lb is not None and lb.maxLedger and \
+                    lb.minLedger > lb.maxLedger:
+                return TC.txMALFORMED
+            if v2.minSeqNum is not None and v2.minSeqNum < 0:
+                return TC.txMALFORMED
+        if self.is_too_early(header):
+            return TC.txTOO_EARLY
+        if self.is_too_late(header):
+            return TC.txTOO_LATE
+        if charge_fee and self.get_inclusion_fee() < \
+                self.get_min_fee(header):
+            return TC.txINSUFFICIENT_FEE
+        if self.fee_bid() < 0:
+            return TC.txMALFORMED
+        if ltx.load_account(self.source_account_id()) is None:
+            return TC.txNO_ACCOUNT
+        return TC.txSUCCESS
+
+    def _check_seq_num(self, acc, header, current_seq: int = 0) -> bool:
+        """ref isBadSeq: normally tx.seqNum == acc.seqNum + 1; with
+        PreconditionsV2.minSeqNum the window [minSeqNum, tx.seqNum) is
+        allowed.  ``current_seq`` (ref checkValid's 'current' arg) overrides
+        the account seq when validating chained txs in a candidate set."""
+        if self.tx.seqNum < 0:
+            return False
+        # starting seqnum of a new account in this ledger cannot collide
+        starting = (header.ledgerSeq << 32)
+        if self.tx.seqNum == starting:
+            return False
+        base = current_seq if current_seq else acc.seqNum
+        v2 = self._v2()
+        if v2 is not None and v2.minSeqNum is not None:
+            return v2.minSeqNum <= base < self.tx.seqNum
+        return base + 1 == self.tx.seqNum
+
+    def common_valid(self, ltx, apply_seq: bool, charge_fee: bool,
+                     current_seq: int = 0) -> int:
+        """ref commonValid (TransactionFrame.cpp:1105)."""
+        res = self.common_valid_pre_seqnum(ltx, charge_fee)
+        if res != TC.txSUCCESS:
+            return res
+        header = ltx.header()
+        entry = ltx.load_account(self.source_account_id())
+        acc = entry.data.value
+        # when applying (post processFeeSeqNum) the seqnum was already
+        # checked and consumed at the fee phase — skip the state checks
+        # (ref commonValid: applying && protocol >= 10)
+        if not apply_seq:
+            if not self._check_seq_num(acc, header, current_seq):
+                return TC.txBAD_SEQ
+            v2 = self._v2()
+            if v2 is not None:
+                if v2.minSeqAge:
+                    age = header.scpValue.closeTime - U.seq_time(acc)
+                    if age < v2.minSeqAge:
+                        return TC.txBAD_MIN_SEQ_AGE_OR_GAP
+                if v2.minSeqLedgerGap:
+                    gap = header.ledgerSeq + 1 - U.seq_ledger(acc)
+                    if gap < v2.minSeqLedgerGap:
+                        return TC.txBAD_MIN_SEQ_AGE_OR_GAP
+        if charge_fee:
+            # fee must be payable above the reserve
+            _, selling = U.account_liabilities(acc)
+            available = (acc.balance - selling
+                         - U.min_balance(header, acc))
+            if available < self.get_full_fee():
+                return TC.txINSUFFICIENT_BALANCE
+        return TC.txSUCCESS
+
+    def process_signatures(self, ltx, checker: SignatureChecker) -> int:
+        """Tx-level (fee-source low threshold) + extra-signers checks
+        (ref processSignatures :1022)."""
+        entry = ltx.load_account(self.source_account_id())
+        acc = entry.data.value
+        needed = U.threshold(acc, U.ThresholdLevel.LOW)
+        if not checker.check_signature(account_signers(acc),
+                                       max(needed, 1)):
+            return TC.txBAD_AUTH
+        v2 = self._v2()
+        if v2 is not None:
+            for skey in v2.extraSigners:
+                if not checker.check_signature([(skey, 1)], 1):
+                    return TC.txBAD_AUTH
+        return TC.txSUCCESS
+
+    def check_valid(self, ltx_parent, current_seq: int = 0,
+                    verify: Optional[Callable] = None) -> ValidationResult:
+        """Full admission-time validity (ref checkValid :1339): structure,
+        preconditions, fee, seqnum, signatures for the tx AND every op.
+        Read-only — runs in a throwaway LedgerTxn.  ``current_seq``
+        validates a tx whose predecessors (consuming seqs up to that value)
+        are already in the candidate set."""
+        with LedgerTxn(ltx_parent) as ltx:
+            checker = SignatureChecker(
+                self.full_hash(), self.signatures, verify)
+            res = self.common_valid(ltx, apply_seq=False, charge_fee=True,
+                                    current_seq=current_seq)
+            if res != TC.txSUCCESS:
+                self.result_code = res
+                ltx.rollback()
+                return ValidationResult(res)
+            res = self.process_signatures(ltx, checker)
+            if res != TC.txSUCCESS:
+                self.result_code = res
+                ltx.rollback()
+                return ValidationResult(res)
+            for opf in self.op_frames:
+                if not opf.check_signatures(ltx, checker):
+                    self.result_code = TC.txFAILED
+                    ltx.rollback()
+                    return ValidationResult(TC.txFAILED)
+                if not opf.check_valid(ltx.header()):
+                    self.result_code = TC.txFAILED
+                    ltx.rollback()
+                    return ValidationResult(TC.txFAILED)
+            if not checker.check_all_signatures_used():
+                self.result_code = TC.txBAD_AUTH_EXTRA
+                ltx.rollback()
+                return ValidationResult(TC.txBAD_AUTH_EXTRA)
+            ltx.rollback()
+        self.result_code = TC.txSUCCESS
+        return ValidationResult(TC.txSUCCESS)
+
+    # -- fee + seqnum processing (ledger close phase 1) ---------------------
+
+    def process_fee_seq_num(self, ltx, base_fee: Optional[int]) -> object:
+        """Charge the fee and bump the seqnum (ref processFeeSeqNum :1196).
+        Returns the fee-phase LedgerEntryChanges."""
+        header = ltx.header()
+        fee = self.get_full_fee() if base_fee is None else min(
+            self.get_full_fee(),
+            base_fee * max(1, self.num_operations()))
+        with LedgerTxn(ltx) as inner:
+            entry = inner.load_account(self.source_account_id())
+            if entry is None:
+                raise RuntimeError("fee source vanished")
+            acc = entry.data.value
+            charged = min(fee, acc.balance)
+            self.fee_charged = charged
+            acc = U.add_balance(acc, -charged)
+            hdr = header._replace(feePool=header.feePool + charged)
+            inner.set_header(hdr)
+            acc = U.set_seq_info(
+                acc, self.tx.seqNum, header.ledgerSeq,
+                header.scpValue.closeTime)
+            inner.put(entry._replace(data=T.LedgerEntryData.make(
+                T.LedgerEntryType.ACCOUNT, acc)))
+            changes = inner.changes()
+            inner.commit()
+        return changes
+
+    # -- apply (ledger close phase 2) --------------------------------------
+
+    def apply(self, ltx, verify: Optional[Callable] = None
+              ) -> Tuple[bool, object, object]:
+        """Apply operations all-or-nothing (ref apply :1752 /
+        applyOperations :1388).  Returns (success, TransactionResult,
+        TransactionMeta-v2-value)."""
+        checker = SignatureChecker(self.full_hash(), self.signatures, verify)
+        with LedgerTxn(ltx) as tx_ltx:
+            res = self.common_valid(tx_ltx, apply_seq=True, charge_fee=False)
+            if res == TC.txSUCCESS:
+                res = self.process_signatures(tx_ltx, checker)
+            if res != TC.txSUCCESS:
+                tx_ltx.rollback()
+                self.result_code = res
+                return (False, self._make_result(res, []),
+                        _empty_meta())
+
+            op_results: List[object] = []
+            op_metas: List[object] = []
+            success = True
+            for opf in self.op_frames:
+                with LedgerTxn(tx_ltx) as op_ltx:
+                    ok = opf.apply(op_ltx, checker)
+                    if ok:
+                        op_metas.append(T.OperationMeta.make(
+                            changes=op_ltx.changes()))
+                        op_ltx.commit()
+                    else:
+                        op_ltx.rollback()
+                        success = False
+                op_results.append(opf.result)
+                if not success:
+                    break
+            if success and not checker.check_all_signatures_used():
+                success = False
+                self.result_code = TC.txBAD_AUTH_EXTRA
+                tx_ltx.rollback()
+                return (False,
+                        self._make_result(TC.txBAD_AUTH_EXTRA, []),
+                        _empty_meta())
+            if success:
+                tx_ltx.commit()
+                self.result_code = TC.txSUCCESS
+                # pad remaining results (loop never breaks on success)
+                return (True,
+                        self._make_result(TC.txSUCCESS, op_results),
+                        _meta(op_metas))
+            # failed: fill results for remaining unapplied ops
+            while len(op_results) < len(self.op_frames):
+                idx = len(op_results)
+                opf = self.op_frames[idx]
+                op_results.append(
+                    opf.result if opf.result is not None else
+                    T.OperationResult.make(
+                        T.OperationResultCode.opNOT_SUPPORTED))
+            tx_ltx.rollback()
+            self.result_code = TC.txFAILED
+            return (False, self._make_result(TC.txFAILED, op_results),
+                    _empty_meta())
+
+    def _make_result(self, code: int, op_results: List[object]) -> object:
+        if code in (TC.txSUCCESS, TC.txFAILED):
+            inner = T.TransactionResult.fields[1][1].make(code, op_results)
+        else:
+            inner = T.TransactionResult.fields[1][1].make(code)
+        return T.TransactionResult.make(
+            feeCharged=self.fee_charged,
+            result=inner,
+            ext=T.TransactionResult.fields[2][1].make(0),
+        )
+
+    def result_pair(self, result) -> object:
+        return T.TransactionResultPair.make(
+            transactionHash=self.full_hash(), result=result)
+
+
+def _meta(op_metas: List[object]) -> object:
+    return T.TransactionMeta.make(2, T.TransactionMetaV2.make(
+        txChangesBefore=[], operations=op_metas, txChangesAfter=[]))
+
+
+def _empty_meta() -> object:
+    return _meta([])
+
+
+def tx_frame_from_envelope(network_id: bytes, envelope):
+    """Envelope -> frame (fee-bump aware)."""
+    if envelope.type == T.EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP:
+        from .fee_bump import FeeBumpTransactionFrame
+
+        return FeeBumpTransactionFrame(network_id, envelope)
+    return TransactionFrame(network_id, envelope)

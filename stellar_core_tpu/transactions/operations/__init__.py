@@ -1,0 +1,51 @@
+"""Operation frames: one class per OperationType
+(ref src/transactions/*OpFrame.cpp — SURVEY.md §2.5)."""
+from __future__ import annotations
+
+from ...xdr import types as T
+from .account_ops import (  # noqa: F401
+    AllowTrustOpFrame, BumpSequenceOpFrame, ChangeTrustOpFrame,
+    ClawbackOpFrame, InflationOpFrame, ManageDataOpFrame, SetOptionsOpFrame,
+    SetTrustLineFlagsOpFrame,
+)
+from .base import OperationFrame, op_error, op_inner  # noqa: F401
+from .payments import (  # noqa: F401
+    AccountMergeOpFrame, CreateAccountOpFrame, PaymentOpFrame,
+)
+
+OT = T.OperationType
+
+_REGISTRY = {
+    OT.CREATE_ACCOUNT: CreateAccountOpFrame,
+    OT.PAYMENT: PaymentOpFrame,
+    OT.ACCOUNT_MERGE: AccountMergeOpFrame,
+    OT.BUMP_SEQUENCE: BumpSequenceOpFrame,
+    OT.MANAGE_DATA: ManageDataOpFrame,
+    OT.SET_OPTIONS: SetOptionsOpFrame,
+    OT.CHANGE_TRUST: ChangeTrustOpFrame,
+    OT.ALLOW_TRUST: AllowTrustOpFrame,
+    OT.SET_TRUST_LINE_FLAGS: SetTrustLineFlagsOpFrame,
+    OT.CLAWBACK: ClawbackOpFrame,
+    OT.INFLATION: InflationOpFrame,
+}
+
+
+class NotSupportedOpFrame(OperationFrame):
+    """Placeholder for op types not yet implemented: fails cleanly with
+    opNOT_SUPPORTED instead of crashing (coverage grows per round)."""
+
+    def do_check_valid(self, header):
+        return op_error(T.OperationResultCode.opNOT_SUPPORTED)
+
+    def do_apply(self, ltx):
+        return op_error(T.OperationResultCode.opNOT_SUPPORTED)
+
+
+def make_operation_frame(op, tx) -> OperationFrame:
+    cls = _REGISTRY.get(op.body.type, NotSupportedOpFrame)
+    f = cls(op, tx)
+    return f
+
+
+def register_op(op_type: int, cls) -> None:
+    _REGISTRY[op_type] = cls

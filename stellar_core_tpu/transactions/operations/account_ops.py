@@ -1,0 +1,444 @@
+"""BumpSequence, ManageData, SetOptions, ChangeTrust, AllowTrust,
+SetTrustLineFlags, Clawback op frames
+(ref src/transactions/{BumpSequenceOpFrame,ManageDataOpFrame,
+SetOptionsOpFrame,ChangeTrustOpFrame,AllowTrustOpFrame,
+SetTrustLineFlagsOpFrame,ClawbackOpFrame}.cpp)."""
+from __future__ import annotations
+
+from ...ledger.ledger_txn import entry_to_key
+from ...xdr import types as T
+from .. import utils as U
+from .base import OperationFrame, op_inner
+
+OT = T.OperationType
+INT64_MAX = U.INT64_MAX
+
+
+def _put_account(ltx, entry, acc):
+    ltx.put(entry._replace(
+        data=T.LedgerEntryData.make(T.LedgerEntryType.ACCOUNT, acc)))
+
+
+def _put_trustline(ltx, entry, tl):
+    ltx.put(entry._replace(
+        data=T.LedgerEntryData.make(T.LedgerEntryType.TRUSTLINE, tl)))
+
+
+class BumpSequenceOpFrame(OperationFrame):
+    TYPE = OT.BUMP_SEQUENCE
+    THRESHOLD = U.ThresholdLevel.LOW
+
+    def _res(self, code):
+        return op_inner(self.TYPE, T.BumpSequenceResult.make(code))
+
+    def do_check_valid(self, header):
+        C = T.BumpSequenceResultCode
+        if self.body.bumpTo < 0:
+            return self._res(C.BUMP_SEQUENCE_BAD_SEQ)
+        return None
+
+    def do_apply(self, ltx):
+        C = T.BumpSequenceResultCode
+        header = ltx.header()
+        entry = self.load_source_account(ltx)
+        acc = entry.data.value
+        max_seq = (header.ledgerSeq << 32) - 1
+        if self.body.bumpTo > max_seq:
+            return self._res(C.BUMP_SEQUENCE_BAD_SEQ)
+        if self.body.bumpTo > acc.seqNum:
+            acc = U.set_seq_info(
+                acc, self.body.bumpTo, header.ledgerSeq,
+                header.scpValue.closeTime)
+            _put_account(ltx, entry, acc)
+        return self._res(C.BUMP_SEQUENCE_SUCCESS)
+
+
+class ManageDataOpFrame(OperationFrame):
+    TYPE = OT.MANAGE_DATA
+    THRESHOLD = U.ThresholdLevel.MEDIUM
+
+    def _res(self, code):
+        return op_inner(self.TYPE, T.ManageDataResult.make(code))
+
+    def do_check_valid(self, header):
+        C = T.ManageDataResultCode
+        name = self.body.dataName
+        if not name or len(name) > 64:
+            return self._res(C.MANAGE_DATA_INVALID_NAME)
+        try:
+            name.decode("ascii")
+        except UnicodeDecodeError:
+            return self._res(C.MANAGE_DATA_INVALID_NAME)
+        return None
+
+    def do_apply(self, ltx):
+        C = T.ManageDataResultCode
+        header = ltx.header()
+        src_id = self.source_account_id()
+        acc_entry = self.load_source_account(ltx)
+        acc = acc_entry.data.value
+        existing = ltx.load_data(src_id, self.body.dataName)
+
+        if self.body.dataValue is None:
+            # delete
+            if existing is None:
+                return self._res(C.MANAGE_DATA_NAME_NOT_FOUND)
+            ltx.erase(entry_to_key(existing))
+            acc = acc._replace(numSubEntries=acc.numSubEntries - 1)
+            _put_account(ltx, acc_entry, acc)
+            return self._res(C.MANAGE_DATA_SUCCESS)
+
+        if existing is None:
+            # create: needs a subentry reserve
+            acc2 = acc._replace(numSubEntries=acc.numSubEntries + 1)
+            if acc.balance < U.min_balance(header, acc2):
+                return self._res(C.MANAGE_DATA_LOW_RESERVE)
+            de = T.DataEntry.make(
+                accountID=T.account_id(src_id),
+                dataName=self.body.dataName,
+                dataValue=self.body.dataValue,
+                ext=T.DataEntry.fields[3][1].make(0))
+            ltx.put(U.wrap_entry(T.LedgerEntryType.DATA, de))
+            _put_account(ltx, acc_entry, acc2)
+        else:
+            de = existing.data.value._replace(dataValue=self.body.dataValue)
+            ltx.put(existing._replace(
+                data=T.LedgerEntryData.make(T.LedgerEntryType.DATA, de)))
+        return self._res(C.MANAGE_DATA_SUCCESS)
+
+
+class SetOptionsOpFrame(OperationFrame):
+    TYPE = OT.SET_OPTIONS
+
+    def _res(self, code):
+        return op_inner(self.TYPE, T.SetOptionsResult.make(code))
+
+    def threshold_level(self):
+        b = self.body
+        if (b.masterWeight is not None or b.lowThreshold is not None
+                or b.medThreshold is not None or b.highThreshold is not None
+                or b.signer is not None):
+            return U.ThresholdLevel.HIGH
+        return U.ThresholdLevel.MEDIUM
+
+    def do_check_valid(self, header):
+        C = T.SetOptionsResultCode
+        b = self.body
+        if b.setFlags is not None and b.clearFlags is not None:
+            if b.setFlags & b.clearFlags:
+                return self._res(C.SET_OPTIONS_BAD_FLAGS)
+        for v in (b.masterWeight, b.lowThreshold, b.medThreshold,
+                  b.highThreshold):
+            if v is not None and v > 255:
+                return self._res(C.SET_OPTIONS_THRESHOLD_OUT_OF_RANGE)
+        allowed = T.MASK_ACCOUNT_FLAGS_V17
+        for v in (b.setFlags, b.clearFlags):
+            if v is not None and v & ~allowed:
+                return self._res(C.SET_OPTIONS_UNKNOWN_FLAG)
+        if b.homeDomain is not None:
+            try:
+                b.homeDomain.decode("ascii")
+            except UnicodeDecodeError:
+                return self._res(C.SET_OPTIONS_INVALID_HOME_DOMAIN)
+        if b.signer is not None:
+            if b.signer.key.value == self.source_account_id() and \
+                    b.signer.key.type == \
+                    T.SignerKeyType.SIGNER_KEY_TYPE_ED25519:
+                return self._res(C.SET_OPTIONS_BAD_SIGNER)
+        return None
+
+    def do_apply(self, ltx):
+        C = T.SetOptionsResultCode
+        header = ltx.header()
+        b = self.body
+        entry = self.load_source_account(ltx)
+        acc = entry.data.value
+
+        if b.inflationDest is not None:
+            if ltx.load_account(b.inflationDest.value) is None:
+                return self._res(C.SET_OPTIONS_INVALID_INFLATION)
+            acc = acc._replace(inflationDest=b.inflationDest)
+
+        flags = acc.flags
+        if b.clearFlags is not None:
+            if flags & T.AUTH_IMMUTABLE_FLAG and \
+                    b.clearFlags & T.MASK_ACCOUNT_FLAGS:
+                return self._res(C.SET_OPTIONS_CANT_CHANGE)
+            flags &= ~b.clearFlags
+        if b.setFlags is not None:
+            if acc.flags & T.AUTH_IMMUTABLE_FLAG and \
+                    b.setFlags & T.MASK_ACCOUNT_FLAGS:
+                return self._res(C.SET_OPTIONS_CANT_CHANGE)
+            flags |= b.setFlags
+        # AUTH_REVOCABLE required for clawback
+        if flags & T.AUTH_CLAWBACK_ENABLED_FLAG and \
+                not flags & T.AUTH_REVOCABLE_FLAG:
+            return self._res(C.SET_OPTIONS_AUTH_REVOCABLE_REQUIRED)
+        acc = acc._replace(flags=flags)
+
+        th = bytearray(acc.thresholds)
+        if b.masterWeight is not None:
+            th[0] = b.masterWeight
+        if b.lowThreshold is not None:
+            th[1] = b.lowThreshold
+        if b.medThreshold is not None:
+            th[2] = b.medThreshold
+        if b.highThreshold is not None:
+            th[3] = b.highThreshold
+        acc = acc._replace(thresholds=bytes(th))
+
+        if b.homeDomain is not None:
+            acc = acc._replace(homeDomain=b.homeDomain)
+
+        if b.signer is not None:
+            signers = list(acc.signers)
+            skey_b = T.SignerKey.encode(b.signer.key)
+            idx = next(
+                (i for i, s in enumerate(signers)
+                 if T.SignerKey.encode(s.key) == skey_b), None)
+            if b.signer.weight == 0:
+                if idx is None:
+                    return self._res(C.SET_OPTIONS_BAD_SIGNER)
+                signers.pop(idx)
+                acc = acc._replace(numSubEntries=acc.numSubEntries - 1)
+            elif idx is not None:
+                signers[idx] = b.signer
+            else:
+                if len(signers) >= T.MAX_SIGNERS:
+                    return self._res(C.SET_OPTIONS_TOO_MANY_SIGNERS)
+                acc2 = acc._replace(numSubEntries=acc.numSubEntries + 1)
+                if acc.balance < U.min_balance(header, acc2):
+                    return self._res(C.SET_OPTIONS_LOW_RESERVE)
+                acc = acc2
+                signers.append(b.signer)
+            signers.sort(key=lambda s: T.SignerKey.encode(s.key))
+            acc = acc._replace(signers=signers)
+
+        _put_account(ltx, entry, acc)
+        return self._res(C.SET_OPTIONS_SUCCESS)
+
+
+class ChangeTrustOpFrame(OperationFrame):
+    TYPE = OT.CHANGE_TRUST
+    THRESHOLD = U.ThresholdLevel.MEDIUM
+
+    def _res(self, code):
+        return op_inner(self.TYPE, T.ChangeTrustResult.make(code))
+
+    def do_check_valid(self, header):
+        C = T.ChangeTrustResultCode
+        line = self.body.line
+        if line.type == T.AssetType.ASSET_TYPE_POOL_SHARE:
+            return self._res(C.CHANGE_TRUST_MALFORMED)  # pools: not yet
+        if line.type == T.AssetType.ASSET_TYPE_NATIVE:
+            return self._res(C.CHANGE_TRUST_MALFORMED)
+        asset = T.Asset.make(line.type, line.value)
+        if not U.is_asset_valid(asset):
+            return self._res(C.CHANGE_TRUST_MALFORMED)
+        if self.body.limit < 0:
+            return self._res(C.CHANGE_TRUST_MALFORMED)
+        if U.asset_issuer(asset) == self.source_account_id():
+            return self._res(C.CHANGE_TRUST_SELF_NOT_ALLOWED)
+        return None
+
+    def do_apply(self, ltx):
+        C = T.ChangeTrustResultCode
+        header = ltx.header()
+        src_id = self.source_account_id()
+        asset = T.Asset.make(self.body.line.type, self.body.line.value)
+        limit = self.body.limit
+        acc_entry = self.load_source_account(ltx)
+        acc = acc_entry.data.value
+        tl_entry = ltx.load_trustline(src_id, asset)
+
+        if limit == 0:
+            if tl_entry is None:
+                return self._res(C.CHANGE_TRUST_TRUST_LINE_MISSING)
+            tl = tl_entry.data.value
+            if tl.balance != 0:
+                return self._res(C.CHANGE_TRUST_INVALID_LIMIT)
+            bl, sl = U.trustline_liabilities(tl)
+            if bl or sl:
+                return self._res(C.CHANGE_TRUST_CANNOT_DELETE)
+            ltx.erase(entry_to_key(tl_entry))
+            acc = acc._replace(numSubEntries=acc.numSubEntries - 1)
+            _put_account(ltx, acc_entry, acc)
+            return self._res(C.CHANGE_TRUST_SUCCESS)
+
+        issuer_id = U.asset_issuer(asset)
+        if tl_entry is None:
+            if ltx.load_account(issuer_id) is None:
+                return self._res(C.CHANGE_TRUST_NO_ISSUER)
+            acc2 = acc._replace(numSubEntries=acc.numSubEntries + 1)
+            if acc.balance < U.min_balance(header, acc2):
+                return self._res(C.CHANGE_TRUST_LOW_RESERVE)
+            issuer_entry = ltx.load_account(issuer_id)
+            issuer = issuer_entry.data.value
+            flags = 0
+            if not issuer.flags & T.AUTH_REQUIRED_FLAG:
+                flags |= T.AUTHORIZED_FLAG
+            if issuer.flags & T.AUTH_CLAWBACK_ENABLED_FLAG:
+                flags |= T.TRUSTLINE_CLAWBACK_ENABLED_FLAG
+            ltx.put(U.make_trustline_entry(
+                src_id, asset, balance=0, limit=limit, flags=flags))
+            _put_account(ltx, acc_entry, acc2)
+        else:
+            tl = tl_entry.data.value
+            buying, _ = U.trustline_liabilities(tl)
+            if limit < tl.balance + buying:
+                return self._res(C.CHANGE_TRUST_INVALID_LIMIT)
+            if ltx.load_account(issuer_id) is None:
+                return self._res(C.CHANGE_TRUST_NO_ISSUER)
+            tl = tl._replace(limit=limit)
+            _put_trustline(ltx, tl_entry, tl)
+        return self._res(C.CHANGE_TRUST_SUCCESS)
+
+
+class AllowTrustOpFrame(OperationFrame):
+    TYPE = OT.ALLOW_TRUST
+    THRESHOLD = U.ThresholdLevel.LOW
+
+    def _res(self, code):
+        return op_inner(self.TYPE, T.AllowTrustResult.make(code))
+
+    def do_check_valid(self, header):
+        C = T.AllowTrustResultCode
+        b = self.body
+        if b.asset.type == T.AssetType.ASSET_TYPE_NATIVE:
+            return self._res(C.ALLOW_TRUST_MALFORMED)
+        mask = (T.AUTHORIZED_FLAG
+                | T.AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG)
+        if b.authorize & ~mask:
+            return self._res(C.ALLOW_TRUST_MALFORMED)
+        if b.trustor.value == self.source_account_id():
+            return self._res(C.ALLOW_TRUST_SELF_NOT_ALLOWED)
+        return None
+
+    def do_apply(self, ltx):
+        C = T.AllowTrustResultCode
+        src_id = self.source_account_id()
+        issuer_entry = self.load_source_account(ltx)
+        issuer = issuer_entry.data.value
+        if not issuer.flags & T.AUTH_REQUIRED_FLAG:
+            return self._res(C.ALLOW_TRUST_TRUST_NOT_REQUIRED)
+        # build the full asset with self as issuer
+        if self.body.asset.type == T.AssetType.ASSET_TYPE_CREDIT_ALPHANUM4:
+            asset = U.asset_alphanum4(self.body.asset.value, src_id)
+        else:
+            asset = U.asset_alphanum12(self.body.asset.value, src_id)
+        tl_entry = ltx.load_trustline(self.body.trustor.value, asset)
+        if tl_entry is None:
+            return self._res(C.ALLOW_TRUST_NO_TRUST_LINE)
+        tl = tl_entry.data.value
+        mask = (T.AUTHORIZED_FLAG
+                | T.AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG)
+        cur = tl.flags & mask
+        new = self.body.authorize
+        # any downgrade of auth requires AUTH_REVOCABLE
+        downgrade = (
+            (cur & T.AUTHORIZED_FLAG and new != T.AUTHORIZED_FLAG)
+            or (cur & T.AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG
+                and new == 0))
+        if downgrade and not issuer.flags & T.AUTH_REVOCABLE_FLAG:
+            return self._res(C.ALLOW_TRUST_CANT_REVOKE)
+        tl = tl._replace(flags=(tl.flags & ~mask) | new)
+        _put_trustline(ltx, tl_entry, tl)
+        return self._res(C.ALLOW_TRUST_SUCCESS)
+
+
+class SetTrustLineFlagsOpFrame(OperationFrame):
+    TYPE = OT.SET_TRUST_LINE_FLAGS
+    THRESHOLD = U.ThresholdLevel.LOW
+
+    def _res(self, code):
+        return op_inner(self.TYPE, T.SetTrustLineFlagsResult.make(code))
+
+    def do_check_valid(self, header):
+        C = T.SetTrustLineFlagsResultCode
+        b = self.body
+        if b.trustor.value == self.source_account_id():
+            return self._res(C.SET_TRUST_LINE_FLAGS_MALFORMED)
+        if not U.is_asset_valid(b.asset) or U.is_native(b.asset):
+            return self._res(C.SET_TRUST_LINE_FLAGS_MALFORMED)
+        if U.asset_issuer(b.asset) != self.source_account_id():
+            return self._res(C.SET_TRUST_LINE_FLAGS_MALFORMED)
+        if b.setFlags & b.clearFlags:
+            return self._res(C.SET_TRUST_LINE_FLAGS_MALFORMED)
+        allowed = T.MASK_TRUSTLINE_FLAGS_V17
+        if b.setFlags & ~allowed or b.clearFlags & ~allowed:
+            return self._res(C.SET_TRUST_LINE_FLAGS_MALFORMED)
+        if b.setFlags & T.TRUSTLINE_CLAWBACK_ENABLED_FLAG:
+            return self._res(C.SET_TRUST_LINE_FLAGS_MALFORMED)
+        return None
+
+    def do_apply(self, ltx):
+        C = T.SetTrustLineFlagsResultCode
+        issuer_entry = self.load_source_account(ltx)
+        issuer = issuer_entry.data.value
+        b = self.body
+        revoking = bool(b.clearFlags & (
+            T.AUTHORIZED_FLAG
+            | T.AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG))
+        if revoking and not issuer.flags & T.AUTH_REVOCABLE_FLAG:
+            return self._res(C.SET_TRUST_LINE_FLAGS_CANT_REVOKE)
+        tl_entry = ltx.load_trustline(b.trustor.value, b.asset)
+        if tl_entry is None:
+            return self._res(C.SET_TRUST_LINE_FLAGS_NO_TRUST_LINE)
+        tl = tl_entry.data.value
+        flags = (tl.flags & ~b.clearFlags) | b.setFlags
+        # invalid state: both AUTHORIZED and MAINTAIN_LIABILITIES
+        if (flags & T.AUTHORIZED_FLAG
+                and flags & T.AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG):
+            return self._res(C.SET_TRUST_LINE_FLAGS_INVALID_STATE)
+        tl = tl._replace(flags=flags)
+        _put_trustline(ltx, tl_entry, tl)
+        return self._res(C.SET_TRUST_LINE_FLAGS_SUCCESS)
+
+
+class ClawbackOpFrame(OperationFrame):
+    TYPE = OT.CLAWBACK
+    THRESHOLD = U.ThresholdLevel.MEDIUM
+
+    def _res(self, code):
+        return op_inner(self.TYPE, T.ClawbackResult.make(code))
+
+    def do_check_valid(self, header):
+        C = T.ClawbackResultCode
+        b = self.body
+        if b.amount <= 0:
+            return self._res(C.CLAWBACK_MALFORMED)
+        if not U.is_asset_valid(b.asset) or U.is_native(b.asset):
+            return self._res(C.CLAWBACK_MALFORMED)
+        if U.asset_issuer(b.asset) != self.source_account_id():
+            return self._res(C.CLAWBACK_MALFORMED)
+        return None
+
+    def do_apply(self, ltx):
+        C = T.ClawbackResultCode
+        b = self.body
+        from_id = U.muxed_to_account_id(b.from_)
+        tl_entry = ltx.load_trustline(from_id, b.asset)
+        if tl_entry is None:
+            return self._res(C.CLAWBACK_NO_TRUST)
+        tl = tl_entry.data.value
+        if not U.is_clawback_enabled_tl(tl):
+            return self._res(C.CLAWBACK_NOT_CLAWBACK_ENABLED)
+        if U.trustline_available_balance(tl) < b.amount:
+            return self._res(C.CLAWBACK_UNDERFUNDED)
+        tl = tl._replace(balance=tl.balance - b.amount)
+        _put_trustline(ltx, tl_entry, tl)
+        return self._res(C.CLAWBACK_SUCCESS)
+
+
+class InflationOpFrame(OperationFrame):
+    TYPE = OT.INFLATION
+    THRESHOLD = U.ThresholdLevel.LOW
+
+    def _res(self, code, payouts=None):
+        return op_inner(self.TYPE, T.InflationResult.make(
+            code, payouts if code == 0 else None))
+
+    def do_apply(self, ltx):
+        # protocol >= 12: inflation is disabled, always NOT_TIME
+        # (ref InflationOpFrame.cpp protocol gate)
+        return self._res(T.InflationResultCode.INFLATION_NOT_TIME)

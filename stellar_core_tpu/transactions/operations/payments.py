@@ -1,0 +1,178 @@
+"""CreateAccount + Payment + AccountMerge op frames
+(ref src/transactions/{CreateAccountOpFrame,PaymentOpFrame,
+MergeOpFrame}.cpp)."""
+from __future__ import annotations
+
+from ...xdr import types as T
+from .. import utils as U
+from .base import OperationFrame, op_error, op_inner
+
+OT = T.OperationType
+
+
+class CreateAccountOpFrame(OperationFrame):
+    TYPE = OT.CREATE_ACCOUNT
+    THRESHOLD = U.ThresholdLevel.MEDIUM
+
+    def _res(self, code):
+        return op_inner(self.TYPE, T.CreateAccountResult.make(code))
+
+    def do_check_valid(self, header):
+        C = T.CreateAccountResultCode
+        if self.body.startingBalance < 0:
+            return self._res(C.CREATE_ACCOUNT_MALFORMED)
+        if self.body.destination.value == self.source_account_id():
+            return self._res(C.CREATE_ACCOUNT_MALFORMED)
+        return None
+
+    def do_apply(self, ltx):
+        C = T.CreateAccountResultCode
+        header = ltx.header()
+        dest = self.body.destination.value
+        if ltx.load_account(dest) is not None:
+            return self._res(C.CREATE_ACCOUNT_ALREADY_EXIST)
+        # destination must be fundable to at least the base reserve
+        if self.body.startingBalance < 2 * header.baseReserve:
+            return self._res(C.CREATE_ACCOUNT_LOW_RESERVE)
+        src_entry = self.load_source_account(ltx)
+        src = src_entry.data.value
+        if U.get_available_balance(header, src) < self.body.startingBalance:
+            return self._res(C.CREATE_ACCOUNT_UNDERFUNDED)
+        src = U.add_balance(src, -self.body.startingBalance)
+        ltx.put(src_entry._replace(
+            data=T.LedgerEntryData.make(T.LedgerEntryType.ACCOUNT, src)))
+        ltx.put(U.make_account_entry(dest, self.body.startingBalance))
+        return self._res(C.CREATE_ACCOUNT_SUCCESS)
+
+
+class PaymentOpFrame(OperationFrame):
+    TYPE = OT.PAYMENT
+    THRESHOLD = U.ThresholdLevel.MEDIUM
+
+    def _res(self, code):
+        return op_inner(self.TYPE, T.PaymentResult.make(code))
+
+    def do_check_valid(self, header):
+        C = T.PaymentResultCode
+        if self.body.amount <= 0:
+            return self._res(C.PAYMENT_MALFORMED)
+        if not U.is_asset_valid(self.body.asset):
+            return self._res(C.PAYMENT_MALFORMED)
+        return None
+
+    def do_apply(self, ltx):
+        C = T.PaymentResultCode
+        header = ltx.header()
+        asset = self.body.asset
+        amount = self.body.amount
+        src_id = self.source_account_id()
+        dest_id = U.muxed_to_account_id(self.body.destination)
+
+        if U.is_native(asset):
+            dest_entry = ltx.load_account(dest_id)
+            if dest_entry is None:
+                return self._res(C.PAYMENT_NO_DESTINATION)
+            if src_id == dest_id:
+                return self._res(C.PAYMENT_SUCCESS)  # self-payment no-op
+            src_entry = self.load_source_account(ltx)
+            src = src_entry.data.value
+            if U.get_available_balance(header, src) < amount:
+                return self._res(C.PAYMENT_UNDERFUNDED)
+            dest = dest_entry.data.value
+            if U.get_max_receive(header, dest) < amount:
+                return self._res(C.PAYMENT_LINE_FULL)
+            src = U.add_balance(src, -amount)
+            dest = U.add_balance(dest, amount)
+            ltx.put(src_entry._replace(data=T.LedgerEntryData.make(
+                T.LedgerEntryType.ACCOUNT, src)))
+            ltx.put(dest_entry._replace(data=T.LedgerEntryData.make(
+                T.LedgerEntryType.ACCOUNT, dest)))
+            return self._res(C.PAYMENT_SUCCESS)
+
+        # credit asset
+        issuer = U.asset_issuer(asset)
+        src_is_issuer = src_id == issuer
+        dest_is_issuer = dest_id == issuer
+
+        if not src_is_issuer:
+            tl_entry = ltx.load_trustline(src_id, asset)
+            if tl_entry is None:
+                return self._res(C.PAYMENT_SRC_NO_TRUST)
+            tl = tl_entry.data.value
+            if not U.is_authorized(tl):
+                return self._res(C.PAYMENT_SRC_NOT_AUTHORIZED)
+            if U.trustline_available_balance(tl) < amount:
+                return self._res(C.PAYMENT_UNDERFUNDED)
+        if not dest_is_issuer:
+            if ltx.load_account(dest_id) is None:
+                return self._res(C.PAYMENT_NO_DESTINATION)
+            dtl_entry = ltx.load_trustline(dest_id, asset)
+            if dtl_entry is None:
+                return self._res(C.PAYMENT_NO_TRUST)
+            dtl = dtl_entry.data.value
+            if not U.is_authorized(dtl):
+                return self._res(C.PAYMENT_NOT_AUTHORIZED)
+            if U.trustline_max_receive(dtl) < amount:
+                return self._res(C.PAYMENT_LINE_FULL)
+
+        if not src_is_issuer:
+            tl = tl_entry.data.value._replace(
+                balance=tl_entry.data.value.balance - amount)
+            ltx.put(tl_entry._replace(data=T.LedgerEntryData.make(
+                T.LedgerEntryType.TRUSTLINE, tl)))
+        if not dest_is_issuer:
+            dtl = dtl_entry.data.value._replace(
+                balance=dtl_entry.data.value.balance + amount)
+            ltx.put(dtl_entry._replace(data=T.LedgerEntryData.make(
+                T.LedgerEntryType.TRUSTLINE, dtl)))
+        return self._res(C.PAYMENT_SUCCESS)
+
+
+class AccountMergeOpFrame(OperationFrame):
+    TYPE = OT.ACCOUNT_MERGE
+    THRESHOLD = U.ThresholdLevel.HIGH
+
+    def _res_code(self, code):
+        return op_inner(self.TYPE, T.AccountMergeResult.make(code))
+
+    def do_check_valid(self, header):
+        C = T.AccountMergeResultCode
+        dest = U.muxed_to_account_id(self.body)
+        if dest == self.source_account_id():
+            return self._res_code(C.ACCOUNT_MERGE_MALFORMED)
+        return None
+
+    def do_apply(self, ltx):
+        C = T.AccountMergeResultCode
+        header = ltx.header()
+        src_id = self.source_account_id()
+        dest_id = U.muxed_to_account_id(self.body)
+
+        dest_entry = ltx.load_account(dest_id)
+        if dest_entry is None:
+            return self._res_code(C.ACCOUNT_MERGE_NO_ACCOUNT)
+        src_entry = self.load_source_account(ltx)
+        src = src_entry.data.value
+        if src.flags & T.AUTH_IMMUTABLE_FLAG:
+            return self._res_code(C.ACCOUNT_MERGE_IMMUTABLE_SET)
+        if src.numSubEntries != 0:
+            return self._res_code(C.ACCOUNT_MERGE_HAS_SUB_ENTRIES)
+        if U.num_sponsoring(src) != 0:
+            return self._res_code(C.ACCOUNT_MERGE_IS_SPONSOR)
+        # seqnum must not be re-usable in this ledger (protocol >= 10)
+        max_seq = (header.ledgerSeq << 32) - 1
+        if src.seqNum >= max_seq:
+            return self._res_code(C.ACCOUNT_MERGE_SEQNUM_TOO_FAR)
+        dest = dest_entry.data.value
+        if U.get_max_receive(header, dest) < src.balance:
+            return self._res_code(C.ACCOUNT_MERGE_DEST_FULL)
+
+        balance = src.balance
+        dest = U.add_balance(dest, balance)
+        ltx.put(dest_entry._replace(data=T.LedgerEntryData.make(
+            T.LedgerEntryType.ACCOUNT, dest)))
+        from ...ledger.ledger_txn import entry_to_key
+
+        ltx.erase(entry_to_key(src_entry))
+        return op_inner(self.TYPE, T.AccountMergeResult.make(
+            C.ACCOUNT_MERGE_SUCCESS, balance))

@@ -1,0 +1,112 @@
+"""SignatureChecker: weighted-threshold multisig evaluation
+(ref src/transactions/SignatureChecker.cpp:31-120).
+
+Holds a tx's DecoratedSignatures; ``check_signature`` consumes them against
+a signer set until the needed weight is reached; ``check_all_signatures_
+used`` enforces txBAD_AUTH_EXTRA semantics.  The actual ed25519 verify
+routes through the pluggable crypto backend (CPU libsodium-class or the
+batched TPU kernel — the --crypto-backend=tpu seam, SURVEY.md §7).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..crypto import verify_sig
+from ..xdr import types as T
+
+
+def signature_hint(pubkey: bytes) -> bytes:
+    """Last 4 bytes of the key (ref SignatureUtils::getHint)."""
+    return pubkey[-4:]
+
+
+class SignatureChecker:
+    def __init__(self, tx_hash: bytes, signatures: Sequence,
+                 verify: Optional[Callable[[bytes, bytes, bytes], bool]]
+                 = None):
+        self.tx_hash = tx_hash
+        self.signatures = list(signatures)
+        self.used = [False] * len(self.signatures)
+        self._verify = verify or (
+            lambda pub, sig, msg: verify_sig(pub, sig, msg))
+
+    def check_signature(self, signers: List[Tuple[object, int]],
+                        needed_weight: int) -> bool:
+        """signers: [(SignerKey value, weight)]; consume matching signatures
+        until total weight >= needed_weight.  A weight sum capped at 255
+        like the reference (uint8 accumulation with saturation at >255
+        handled by int here)."""
+        # semantics mirror the reference exactly: the used[] flags feed ONLY
+        # check_all_signatures_used (txBAD_AUTH_EXTRA) — a signature verified
+        # for the tx-level check is counted again by per-op checks.  Within
+        # one call, signatures iterate outermost and a matched signer is
+        # retired, so each signer contributes at most once per call; weights
+        # saturate at 255 (ref SignatureChecker.cpp:31-120).
+        total = 0
+        SK = T.SignerKeyType
+
+        # pre-auth-tx signers match the tx hash directly, no signature bytes
+        for skey, weight in signers:
+            if skey.type == SK.SIGNER_KEY_TYPE_PRE_AUTH_TX and \
+                    skey.value == self.tx_hash:
+                total += min(weight, 255)
+                if total >= needed_weight:
+                    return True
+
+        remaining = [
+            (skey, weight) for skey, weight in signers
+            if skey.type != SK.SIGNER_KEY_TYPE_PRE_AUTH_TX and weight > 0
+        ]
+        for i, ds in enumerate(self.signatures):
+            for j, (skey, weight) in enumerate(remaining):
+                t = skey.type
+                if t == SK.SIGNER_KEY_TYPE_ED25519:
+                    pub = skey.value
+                    if ds.hint != signature_hint(pub):
+                        continue
+                    if not self._verify(pub, ds.signature, self.tx_hash):
+                        continue
+                elif t == SK.SIGNER_KEY_TYPE_HASH_X:
+                    if ds.hint != signature_hint(skey.value):
+                        continue
+                    if hashlib.sha256(ds.signature).digest() != skey.value:
+                        continue
+                elif t == SK.SIGNER_KEY_TYPE_ED25519_SIGNED_PAYLOAD:
+                    sp = skey.value
+                    pub = sp.ed25519
+                    # hint = payload-hint XOR key-hint (protocol 19)
+                    ph = sp.payload[-4:].ljust(4, b"\x00")
+                    want = bytes(a ^ b for a, b in
+                                 zip(signature_hint(pub), ph))
+                    if ds.hint != want:
+                        continue
+                    if not self._verify(pub, ds.signature, sp.payload):
+                        continue
+                else:
+                    continue
+                self.used[i] = True
+                total += min(weight, 255)
+                if total >= needed_weight:
+                    return True
+                remaining.pop(j)
+                break
+        return False
+
+    def check_all_signatures_used(self) -> bool:
+        return all(self.used)
+
+
+def account_signers(account_entry) -> List[Tuple[object, int]]:
+    """Master key + additional signers as (SignerKey, weight) pairs."""
+    acc = account_entry
+    out: List[Tuple[object, int]] = []
+    mw = acc.thresholds[0]
+    out.append((
+        T.SignerKey.make(T.SignerKeyType.SIGNER_KEY_TYPE_ED25519,
+                         acc.accountID.value),
+        mw,
+    ))
+    for s in acc.signers:
+        out.append((s.key, s.weight))
+    return out

@@ -1,0 +1,182 @@
+"""VirtualClock + VirtualTimer: the event loop of every node.
+
+Two modes like the reference (ref src/util/Timer.h:64-223):
+- REAL_TIME: now() is wall-clock; crank() dispatches due work.
+- VIRTUAL_TIME: now() only advances when cranked and jumps straight to the
+  next scheduled event — whole multi-node networks simulate deterministically
+  at accelerated time in one process (ref docs/architecture.md:33-36).
+
+The host event loop stays single-threaded by design (ref
+docs/architecture.md:24-27; SURVEY.md §2.17 P1): consensus/state mutation
+all happens on the crank thread, while TPU work is dispatched
+asynchronously through jax and joined at batch boundaries.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import time as _time
+from enum import Enum
+from typing import Callable, List, Optional, Tuple
+
+
+class ClockMode(Enum):
+    REAL_TIME = 0
+    VIRTUAL_TIME = 1
+
+
+class VirtualClock:
+    def __init__(self, mode: ClockMode = ClockMode.VIRTUAL_TIME):
+        self.mode = mode
+        self._virtual_now = 0.0
+        self._timers: List[Tuple[float, int, "VirtualTimer"]] = []
+        self._seq = itertools.count()
+        self._actions: List[Callable[[], None]] = []
+        self._stopped = False
+
+    # -- time --------------------------------------------------------------
+
+    def now(self) -> float:
+        if self.mode == ClockMode.REAL_TIME:
+            return _time.monotonic()
+        return self._virtual_now
+
+    def system_now(self) -> float:
+        """Wall-clock (unix) time; virtual mode derives it from the virtual
+        offset so close times stay deterministic in simulation."""
+        if self.mode == ClockMode.REAL_TIME:
+            return _time.time()
+        return self._virtual_now
+
+    def set_current_virtual_time(self, t: float) -> None:
+        assert self.mode == ClockMode.VIRTUAL_TIME
+        assert t >= self._virtual_now
+        self._virtual_now = t
+
+    # -- scheduling --------------------------------------------------------
+
+    def post_action(self, action: Callable[[], None]) -> None:
+        """Queue work for the next crank (ref postOnMainThread)."""
+        self._actions.append(action)
+
+    def _enqueue_timer(self, deadline: float, timer: "VirtualTimer",
+                       gen: int) -> None:
+        heapq.heappush(self._timers, (deadline, next(self._seq), timer, gen))
+
+    def next_deadline(self) -> Optional[float]:
+        while self._timers and not self._timers[0][2]._live(
+                self._timers[0][3]):
+            heapq.heappop(self._timers)
+        return self._timers[0][0] if self._timers else None
+
+    # -- crank -------------------------------------------------------------
+
+    def crank(self, block: bool = False) -> int:
+        """Dispatch queued actions + due timers; returns #events dispatched.
+
+        VIRTUAL_TIME: if nothing is due and ``block``, jump time to the next
+        deadline.  REAL_TIME: if nothing is due and ``block``, sleep until
+        the next deadline.
+        """
+        if self._stopped:
+            return 0
+        progress = 0
+
+        actions, self._actions = self._actions, []
+        for a in actions:
+            a()
+            progress += 1
+
+        while True:
+            nd = self.next_deadline()
+            if nd is None:
+                break
+            if nd > self.now():
+                if progress == 0 and block:
+                    if self.mode == ClockMode.VIRTUAL_TIME:
+                        self._virtual_now = nd
+                    else:
+                        _time.sleep(nd - self.now())
+                    continue
+                break
+            _, _, timer, gen = heapq.heappop(self._timers)
+            if not timer._live(gen):
+                continue
+            timer._fire()
+            progress += 1
+            # actions posted by timer callbacks run this crank too
+            actions, self._actions = self._actions, []
+            for a in actions:
+                a()
+                progress += 1
+        return progress
+
+    def crank_until(self, pred: Callable[[], bool],
+                    timeout: float = 100.0) -> bool:
+        """Crank until pred() or the (virtual/real) deadline passes —
+        the test-harness workhorse (ref Simulation::crankUntil)."""
+        deadline = self.now() + timeout
+        while self.now() <= deadline:
+            if pred():
+                return True
+            if self.crank(block=True) == 0 and self.next_deadline() is None:
+                # fully idle: nothing will ever change
+                return pred()
+        return pred()
+
+    def stop(self) -> None:
+        self._stopped = True
+
+
+class VirtualTimer:
+    """One-shot timer owned by a subsystem (ref VirtualTimer).
+
+    expires_from_now/expires_at + async_wait(cb, on_cancel=None); cancel()
+    invokes the cancel handler like asio's operation_aborted path.
+    Cancel-and-rearm is safe: heap entries carry the arming generation, so
+    a stale entry from before a cancel() can never fire a later callback.
+    """
+
+    def __init__(self, clock: VirtualClock):
+        self.clock = clock
+        self.cancelled = False
+        self._cb: Optional[Callable[[], None]] = None
+        self._on_cancel: Optional[Callable[[], None]] = None
+        self._armed = False
+        self._gen = 0  # bumped on every arm/cancel; heap entries snapshot it
+
+    def expires_from_now(self, delay: float) -> None:
+        self._deadline = self.clock.now() + delay
+
+    def expires_at(self, deadline: float) -> None:
+        self._deadline = deadline
+
+    def async_wait(self, cb: Callable[[], None],
+                   on_cancel: Optional[Callable[[], None]] = None) -> None:
+        assert not self._armed, "timer already armed"
+        self.cancelled = False
+        self._cb = cb
+        self._on_cancel = on_cancel
+        self._armed = True
+        self._gen += 1
+        self.clock._enqueue_timer(self._deadline, self, self._gen)
+
+    def cancel(self) -> None:
+        if self._armed and not self.cancelled:
+            self.cancelled = True
+            self._armed = False
+            self._gen += 1  # invalidate the outstanding heap entry
+            if self._on_cancel is not None:
+                cb = self._on_cancel
+                self._on_cancel = None
+                self.clock.post_action(cb)
+
+    def _live(self, gen: int) -> bool:
+        return not self.cancelled and self._armed and gen == self._gen
+
+    def _fire(self) -> None:
+        self._armed = False
+        cb = self._cb
+        self._cb = None
+        if cb is not None:
+            cb()

@@ -1,0 +1,49 @@
+"""Partitioned logging (ref src/util/Logging.h + LogPartitions.def).
+
+15 partitions with independently settable levels, runtime-adjustable via
+the admin ``ll`` endpoint like the reference (ref CommandHandler.cpp:113).
+Built over the stdlib logging module.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+PARTITIONS = [
+    "Fs", "SCP", "Bucket", "Database", "History", "Process", "Ledger",
+    "Overlay", "Herder", "Tx", "LoadGen", "Work", "Invariant", "Perf",
+    "Default",
+]
+
+_loggers: Dict[str, logging.Logger] = {}
+
+
+def get_logger(partition: str) -> logging.Logger:
+    if partition not in PARTITIONS:
+        partition = "Default"
+    lg = _loggers.get(partition)
+    if lg is None:
+        lg = logging.getLogger(f"stellar_core_tpu.{partition}")
+        _loggers[partition] = lg
+    return lg
+
+
+def set_log_level(level: str, partition: Optional[str] = None) -> None:
+    """Set one partition's level, or all when partition is None."""
+    lvl = getattr(logging, level.upper())
+    targets = [partition] if partition else PARTITIONS
+    for p in targets:
+        get_logger(p).setLevel(lvl)
+
+
+def get_log_levels() -> Dict[str, str]:
+    return {
+        p: logging.getLevelName(get_logger(p).getEffectiveLevel())
+        for p in PARTITIONS
+    }
+
+
+def init(level: str = "INFO") -> None:
+    logging.basicConfig(
+        format="%(asctime)s %(name)s [%(levelname)s] %(message)s")
+    set_log_level(level)

@@ -1,0 +1,175 @@
+"""Metrics registry: counters, meters, timers, histograms
+(ref lib/libmedida + docs/metrics.md; exposed via the admin `metrics`
+endpoint like ref src/main/CommandHandler.cpp:116).
+
+Names are dotted triples like the reference's catalog
+("ledger.ledger.close", "scp.envelope.receive").
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import Dict, List, Optional
+
+
+class Counter:
+    def __init__(self):
+        self.count = 0
+
+    def inc(self, n: int = 1):
+        self.count += n
+
+    def dec(self, n: int = 1):
+        self.count -= n
+
+    def set_count(self, n: int):
+        self.count = n
+
+
+class Meter:
+    """Event rate tracker (1m EWMA + total count)."""
+
+    def __init__(self, clock=None):
+        self.count = 0
+        self._rate = 0.0
+        self._last: Optional[float] = None
+        self._clock = clock
+
+    def _now(self) -> float:
+        return self._clock.now() if self._clock else time.monotonic()
+
+    def mark(self, n: int = 1):
+        now = self._now()
+        if self._last is not None:
+            dt = max(now - self._last, 1e-9)
+            inst = n / dt
+            alpha = 1 - math.exp(-dt / 60.0)
+            self._rate += alpha * (inst - self._rate)
+        self._last = now
+        self.count += n
+
+    @property
+    def one_minute_rate(self) -> float:
+        return self._rate
+
+
+class Histogram:
+    """Reservoir-free streaming histogram (count/min/max/mean/percentiles
+    over a sliding sample of 1028 like medida's uniform sample)."""
+
+    MAX_SAMPLES = 1028
+
+    def __init__(self):
+        self.count = 0
+        self._samples: List[float] = []
+        self.min = math.inf
+        self.max = -math.inf
+        self._sum = 0.0
+
+    def update(self, v: float):
+        self.count += 1
+        self._sum += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+        if len(self._samples) < self.MAX_SAMPLES:
+            self._samples.append(v)
+        else:
+            import random
+
+            i = random.randrange(self.count)
+            if i < self.MAX_SAMPLES:
+                self._samples[i] = v
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self.count if self.count else 0.0
+
+    def percentile(self, p: float) -> float:
+        if not self._samples:
+            return 0.0
+        s = sorted(self._samples)
+        k = min(int(p * len(s)), len(s) - 1)
+        return s[k]
+
+    def summary(self) -> dict:
+        return {
+            "count": self.count,
+            "min": 0.0 if self.count == 0 else self.min,
+            "max": 0.0 if self.count == 0 else self.max,
+            "mean": self.mean,
+            "p50": self.percentile(0.50),
+            "p75": self.percentile(0.75),
+            "p99": self.percentile(0.99),
+        }
+
+
+class Timer(Histogram):
+    """Histogram of durations (seconds) + rate."""
+
+    def __init__(self, clock=None):
+        super().__init__()
+        self.meter = Meter(clock)
+
+    def update(self, v: float):
+        super().update(v)
+        self.meter.mark()
+
+    def time_scope(self):
+        return _TimeScope(self)
+
+
+class _TimeScope:
+    def __init__(self, timer: Timer):
+        self.timer = timer
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.timer.update(time.perf_counter() - self._t0)
+        return False
+
+
+class MetricsRegistry:
+    def __init__(self, clock=None):
+        self._clock = clock
+        self._metrics: Dict[str, object] = {}
+
+    def _get(self, name: str, cls, *args):
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = cls(*args)
+        assert isinstance(m, cls), f"{name} registered as {type(m).__name__}"
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def meter(self, name: str) -> Meter:
+        return self._get(name, Meter, self._clock)
+
+    def timer(self, name: str) -> Timer:
+        return self._get(name, Timer, self._clock)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def snapshot(self) -> dict:
+        out = {}
+        for name, m in sorted(self._metrics.items()):
+            if isinstance(m, Counter):
+                out[name] = {"type": "counter", "count": m.count}
+            elif isinstance(m, Timer):
+                out[name] = {"type": "timer", **m.summary(),
+                             "rate1m": m.meter.one_minute_rate}
+            elif isinstance(m, Meter):
+                out[name] = {"type": "meter", "count": m.count,
+                             "rate1m": m.one_minute_rate}
+            elif isinstance(m, Histogram):
+                out[name] = {"type": "histogram", **m.summary()}
+        return out
+
+    def reset(self) -> None:
+        """MetricResetter equivalent for tests."""
+        self._metrics.clear()

@@ -1,0 +1,265 @@
+"""Async work system: finite-state machines for long multi-step tasks
+(ref src/work — BasicWork state diagram at src/work/BasicWork.h:15-60).
+
+States: WAITING / RUNNING / SUCCESS / FAILURE / ABORTED, with retry edges.
+``Work`` composes children; ``WorkScheduler`` is the app-attached root that
+cranks on the main thread; ``BatchWork`` runs a bounded-parallel iterator;
+``WorkSequence`` chains works in order.
+"""
+from __future__ import annotations
+
+from enum import Enum
+from typing import Callable, Dict, Iterator, List, Optional
+
+
+class State(Enum):
+    WAITING = 0
+    RUNNING = 1
+    SUCCESS = 2
+    FAILURE = 3
+    ABORTED = 4
+
+
+class BasicWork:
+    """Subclass and implement on_run() -> State (RUNNING to be rescheduled,
+    WAITING to block on a child/event, SUCCESS/FAILURE when done)."""
+
+    RETRY_NEVER = 0
+    RETRY_ONCE = 1
+    RETRY_A_FEW = 5
+    RETRY_FOREVER = 2**31
+
+    def __init__(self, name: str, max_retries: int = RETRY_A_FEW):
+        self.name = name
+        self.max_retries = max_retries
+        self.state = State.WAITING
+        self.retries = 0
+        self._aborting = False
+
+    # -- subclass surface ---------------------------------------------------
+
+    def on_run(self) -> State:
+        raise NotImplementedError
+
+    def on_reset(self) -> None:
+        pass
+
+    def on_success(self) -> None:
+        pass
+
+    def on_failure_retry(self) -> None:
+        pass
+
+    def on_failure_raise(self) -> None:
+        pass
+
+    def on_abort(self) -> bool:
+        """Return True when abort cleanup is complete."""
+        return True
+
+    # -- engine -------------------------------------------------------------
+
+    def start(self) -> None:
+        self.state = State.RUNNING
+        self.retries = 0
+        self.on_reset()
+
+    def crank(self) -> State:
+        if self.state not in (State.RUNNING, State.WAITING):
+            return self.state
+        if self._aborting:
+            if self.on_abort():
+                self.state = State.ABORTED
+            return self.state
+        nxt = self.on_run()
+        if nxt == State.FAILURE and self.retries < self.max_retries:
+            self.retries += 1
+            self.on_failure_retry()
+            self.on_reset()
+            self.state = State.RUNNING
+            return self.state
+        self.state = nxt
+        if nxt == State.SUCCESS:
+            self.on_success()
+        elif nxt == State.FAILURE:
+            self.on_failure_raise()
+        return self.state
+
+    def abort(self) -> None:
+        if self.state in (State.RUNNING, State.WAITING):
+            self._aborting = True
+
+    @property
+    def done(self) -> bool:
+        return self.state in (State.SUCCESS, State.FAILURE, State.ABORTED)
+
+
+class Work(BasicWork):
+    """A work with children: runs children to completion before itself
+    (ref src/work/Work.h).  Subclasses implement do_work() which may add
+    children via add_work()."""
+
+    def __init__(self, name: str, max_retries: int = BasicWork.RETRY_A_FEW):
+        super().__init__(name, max_retries)
+        self.children: List[BasicWork] = []
+
+    def add_work(self, w: BasicWork) -> BasicWork:
+        w.start()
+        self.children.append(w)
+        return w
+
+    def on_reset(self) -> None:
+        self.children.clear()
+        self.do_reset()
+
+    def do_reset(self) -> None:
+        pass
+
+    def do_work(self) -> State:
+        raise NotImplementedError
+
+    def on_run(self) -> State:
+        # crank one non-done child first (round robin)
+        any_failed = False
+        all_done = True
+        for c in self.children:
+            if not c.done:
+                c.crank()
+            if not c.done:
+                all_done = False
+            elif c.state in (State.FAILURE, State.ABORTED):
+                any_failed = True
+        if any_failed:
+            return State.FAILURE
+        if not all_done:
+            return State.RUNNING
+        return self.do_work()
+
+
+class WorkSequence(BasicWork):
+    """Execute a list of works strictly in order (ref WorkSequence)."""
+
+    def __init__(self, name: str, steps: List[BasicWork]):
+        super().__init__(name, max_retries=BasicWork.RETRY_NEVER)
+        self.steps = steps
+        self._idx = 0
+
+    def on_reset(self) -> None:
+        self._idx = 0
+        for s in self.steps:
+            s.start()
+
+    def on_run(self) -> State:
+        while self._idx < len(self.steps):
+            cur = self.steps[self._idx]
+            if not cur.done:
+                cur.crank()
+            if not cur.done:
+                return State.RUNNING
+            if cur.state != State.SUCCESS:
+                return State.FAILURE
+            self._idx += 1
+        return State.SUCCESS
+
+
+class BatchWork(Work):
+    """Bounded-parallelism iterator (ref src/work/BatchWork.h:19): yields
+    works from ``iterator`` keeping at most ``batch_size`` in flight."""
+
+    def __init__(self, name: str, iterator: Iterator[BasicWork],
+                 batch_size: int = 8):
+        super().__init__(name, max_retries=BasicWork.RETRY_NEVER)
+        self._iter = iterator
+        self.batch_size = batch_size
+        self._exhausted = False
+
+    def do_reset(self) -> None:
+        self._exhausted = False
+
+    def do_work(self) -> State:
+        # drop finished children, top up to batch_size
+        self.children = [c for c in self.children if not c.done]
+        while not self._exhausted and len(self.children) < self.batch_size:
+            try:
+                nxt = next(self._iter)
+            except StopIteration:
+                self._exhausted = True
+                break
+            self.add_work(nxt)
+        if self.children:
+            return State.RUNNING
+        return State.SUCCESS
+
+    def on_run(self) -> State:
+        for c in self.children:
+            if not c.done:
+                c.crank()
+        for c in self.children:
+            if c.done and c.state in (State.FAILURE, State.ABORTED):
+                return State.FAILURE
+        return self.do_work()
+
+
+class WorkWithCallback(BasicWork):
+    def __init__(self, name: str, fn: Callable[[], bool]):
+        super().__init__(name, max_retries=BasicWork.RETRY_NEVER)
+        self.fn = fn
+
+    def on_run(self) -> State:
+        return State.SUCCESS if self.fn() else State.FAILURE
+
+
+class ConditionalWork(BasicWork):
+    """Waits for a condition, then runs the wrapped work."""
+
+    def __init__(self, name: str, condition: Callable[[], bool],
+                 work: BasicWork):
+        super().__init__(name, max_retries=BasicWork.RETRY_NEVER)
+        self.condition = condition
+        self.work = work
+        self._started = False
+
+    def on_run(self) -> State:
+        if not self._started:
+            if not self.condition():
+                return State.RUNNING
+            self.work.start()
+            self._started = True
+        self.work.crank()
+        if not self.work.done:
+            return State.RUNNING
+        return self.work.state
+
+
+class WorkScheduler(Work):
+    """App-attached root work cranked from the main loop
+    (ref src/work/WorkScheduler.h:20-48)."""
+
+    def __init__(self, clock):
+        super().__init__("work-scheduler",
+                         max_retries=BasicWork.RETRY_NEVER)
+        self.clock = clock
+        self.state = State.RUNNING
+
+    def do_work(self) -> State:
+        return State.RUNNING  # the root never finishes
+
+    def schedule(self, w: BasicWork) -> BasicWork:
+        return self.add_work(w)
+
+    def crank_all(self, max_cranks: int = 100_000) -> bool:
+        """Crank until all scheduled works are done (test helper); bounded
+        so stuck works can't hang the caller."""
+
+        def all_done():
+            return all(c.done for c in self.children)
+
+        for _ in range(max_cranks):
+            if all_done():
+                break
+            self.crank()
+            self.clock.crank(block=False)
+            if all(c.state == State.WAITING for c in self.children
+                   if not c.done):
+                break  # blocked on external events with none pending
+        return all_done()

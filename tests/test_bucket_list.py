@@ -1,0 +1,107 @@
+"""BucketList LSM tests (ref model: src/bucket/test/BucketListTests.cpp)."""
+import pytest
+
+from stellar_core_tpu.bucket import (
+    Bucket, BucketList, level_should_spill, level_size,
+)
+from stellar_core_tpu.ledger.ledger_txn import entry_to_key, key_bytes
+from stellar_core_tpu.transactions import utils as U
+from stellar_core_tpu.xdr import types as T
+
+
+def acct(i: int, balance=100):
+    return U.make_account_entry(bytes([i % 256, i // 256]) * 16, balance)
+
+
+def kb_of(entry) -> bytes:
+    return key_bytes(entry_to_key(entry))
+
+
+def test_level_shape_matches_reference():
+    # ref BucketList.cpp:208-217 levelSize = 4^(level+1)
+    assert level_size(0) == 4
+    assert level_size(1) == 16
+    assert level_size(10) == 4**11
+    # ref levelShouldSpill: half-size cadence
+    assert level_should_spill(2, 0)
+    assert not level_should_spill(3, 0)
+    assert level_should_spill(8, 1)
+    assert not level_should_spill(4, 1)
+
+
+def test_hash_changes_and_is_deterministic():
+    def run():
+        bl = BucketList()
+        h = []
+        for seq in range(2, 10):
+            e = acct(seq)
+            h.append(bl.add_batch(seq, [(kb_of(e), e, False)]))
+        return h
+
+    h1, h2 = run(), run()
+    assert h1 == h2
+    assert len(set(h1)) == len(h1)  # every close moves the hash
+
+
+def test_get_entry_and_delete():
+    bl = BucketList()
+    e = acct(1, balance=55)
+    kb = kb_of(e)
+    bl.add_batch(2, [(kb, e, False)])
+    got = bl.get_entry(kb)
+    assert got is not None and got.data.value.balance == 55
+    bl.add_batch(3, [(kb, None, True)])
+    assert bl.get_entry(kb) is None
+
+
+def test_deleted_entry_stays_dead_across_spills():
+    """Regression (review finding): update-then-delete of an entry that
+    spilled to a deeper level must keep its tombstone — the update must not
+    be INITENTRY or the tombstone annihilates and the old entry
+    resurrects."""
+    bl = BucketList()
+    e = acct(7, balance=10)
+    kb = kb_of(e)
+    bl.add_batch(2, [(kb, e, False)])    # create (INIT)
+    # push enough ledgers for level-0 spills to carry it deeper
+    for seq in range(3, 11):
+        filler = acct(100 + seq)
+        bl.add_batch(seq, [(kb_of(filler), filler, False)])
+    # update (existed_before=True -> LIVEENTRY), then delete
+    e2 = acct(7, balance=99)
+    bl.add_batch(11, [(kb, e2, True)])
+    bl.add_batch(12, [(kb, None, True)])
+    assert bl.get_entry(kb) is None
+    # keep spilling: still dead at every depth
+    for seq in range(13, 40):
+        filler = acct(200 + seq)
+        bl.add_batch(seq, [(kb_of(filler), filler, False)])
+        assert bl.get_entry(kb) is None
+    assert kb not in bl.all_live_entries()
+
+
+def test_create_delete_annihilates():
+    bl = BucketList()
+    e = acct(9)
+    kb = kb_of(e)
+    bl.add_batch(2, [(kb, e, False)])
+    bl.add_batch(3, [(kb, None, True)])
+    assert bl.get_entry(kb) is None
+    # merged level-0 curr should not carry a tombstone for a same-level
+    # create+delete once they meet in a merge
+    merged = Bucket.merge(bl.levels[0].curr, bl.levels[0].snap)
+    kinds = [en.type for k, en in merged.entries if k == kb]
+    # either annihilated already or DEAD-over-INIT pending a merge
+    assert kinds in ([], [T.BucketEntryType.DEADENTRY])
+
+
+def test_all_live_entries_flatten():
+    bl = BucketList()
+    entries = [acct(i, balance=i * 10 + 10) for i in range(1, 30)]
+    for seq, e in enumerate(entries, start=2):
+        bl.add_batch(seq, [(kb_of(e), e, False)])
+    live = bl.all_live_entries()
+    assert len(live) == len(entries)
+    for e in entries:
+        assert live[kb_of(e)].data.value.balance == \
+            e.data.value.balance

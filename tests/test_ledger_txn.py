@@ -1,0 +1,138 @@
+"""LedgerTxn nesting semantics (ref model: src/ledger/test/
+LedgerTxnTests.cpp)."""
+import pytest
+
+from stellar_core_tpu.ledger import (
+    LedgerTxn, LedgerTxnError, LedgerTxnRoot, entry_to_key, open_database,
+)
+from stellar_core_tpu.transactions import utils as U
+from stellar_core_tpu.xdr import types as T
+
+from tests.txtest import TestLedger
+
+
+@pytest.fixture()
+def ledger():
+    return TestLedger()
+
+
+def acct(i: int, balance=10**9):
+    return U.make_account_entry(bytes([i]) * 32, balance)
+
+
+def test_nested_commit_and_rollback(ledger):
+    root = ledger.root_txn
+    with LedgerTxn(root) as l1:
+        l1.put(acct(1))
+        with LedgerTxn(l1) as l2:
+            l2.put(acct(2))
+            l2.rollback()
+        with LedgerTxn(l1) as l3:
+            l3.put(acct(3))
+            l3.commit()
+        l1.commit()
+    with LedgerTxn(root) as chk:
+        assert chk.load_account(b"\x01" * 32) is not None
+        assert chk.load_account(b"\x02" * 32) is None
+        assert chk.load_account(b"\x03" * 32) is not None
+        chk.rollback()
+
+
+def test_single_child_enforced(ledger):
+    with LedgerTxn(ledger.root_txn) as l1:
+        l2 = LedgerTxn(l1)
+        with pytest.raises(LedgerTxnError):
+            LedgerTxn(l1)
+        l2.rollback()
+        l1.rollback()
+
+
+def test_erase_and_shadowing(ledger):
+    root = ledger.root_txn
+    with LedgerTxn(root) as l1:
+        l1.put(acct(1))
+        l1.commit()
+    with LedgerTxn(root) as l1:
+        e = l1.load_account(b"\x01" * 32)
+        l1.erase(entry_to_key(e))
+        assert l1.load_account(b"\x01" * 32) is None
+        with LedgerTxn(l1) as l2:
+            # child sees parent's delta
+            assert l2.load_account(b"\x01" * 32) is None
+            l2.put(acct(1, balance=5))
+            l2.commit()
+        assert l1.load_account(b"\x01" * 32).data.value.balance == 5
+        l1.rollback()
+    # rollback: original survives
+    with LedgerTxn(root) as chk:
+        assert chk.load_account(b"\x01" * 32).data.value.balance == 10**9
+        chk.rollback()
+
+
+def test_changes_meta(ledger):
+    root = ledger.root_txn
+    with LedgerTxn(root) as l1:
+        l1.put(acct(1))
+        l1.commit()
+    with LedgerTxn(root) as l1:
+        e = l1.load_account(b"\x01" * 32)
+        l1.put(e._replace(data=T.LedgerEntryData.make(
+            T.LedgerEntryType.ACCOUNT,
+            e.data.value._replace(balance=42))))
+        l1.put(acct(2))
+        changes = l1.changes()
+        l1.rollback()
+    CT = T.LedgerEntryChangeType
+    kinds = [c.type for c in changes]
+    assert kinds.count(CT.LEDGER_ENTRY_STATE) == 1
+    assert kinds.count(CT.LEDGER_ENTRY_UPDATED) == 1
+    assert kinds.count(CT.LEDGER_ENTRY_CREATED) == 1
+
+
+def test_erase_nonexistent_raises(ledger):
+    with LedgerTxn(ledger.root_txn) as l1:
+        e = acct(9)
+        with pytest.raises(LedgerTxnError):
+            l1.erase(entry_to_key(e))
+        l1.rollback()
+
+
+def test_last_modified_stamping(ledger):
+    with LedgerTxn(ledger.root_txn) as l1:
+        l1.put(acct(1))
+        got = l1.load_account(b"\x01" * 32)
+        assert got.lastModifiedLedgerSeq == l1.header().ledgerSeq
+        l1.rollback()
+
+
+def test_best_offer_with_uncommitted_overrides(ledger):
+    root = ledger.root_txn
+    seller = b"\x05" * 32
+    usd = U.make_asset(b"USD", b"\x06" * 32)
+    xlm = U.asset_native()
+
+    def offer(oid, n, d):
+        oe = T.OfferEntry.make(
+            sellerID=T.account_id(seller), offerID=oid,
+            selling=usd, buying=xlm, amount=100,
+            price=T.Price.make(n=n, d=d), flags=0,
+            ext=T.OfferEntry.fields[7][1].make(0))
+        return U.wrap_entry(T.LedgerEntryType.OFFER, oe)
+
+    with LedgerTxn(root) as l1:
+        l1.put(offer(1, 2, 1))  # price 2.0
+        l1.put(offer(2, 1, 1))  # price 1.0 (best)
+        l1.commit()
+    sell_b, buy_b = T.Asset.encode(usd), T.Asset.encode(xlm)
+    with LedgerTxn(root) as l1:
+        best = l1.best_offer(sell_b, buy_b)
+        assert best.data.value.offerID == 2
+        # shadow the best offer in the open txn
+        l1.erase(entry_to_key(best))
+        best2 = l1.best_offer(sell_b, buy_b)
+        assert best2.data.value.offerID == 1
+        # add an even better uncommitted offer
+        l1.put(offer(3, 1, 2))  # price 0.5
+        best3 = l1.best_offer(sell_b, buy_b)
+        assert best3.data.value.offerID == 3
+        l1.rollback()

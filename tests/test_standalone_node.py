@@ -1,0 +1,173 @@
+"""Standalone manual-close node: the minimum end-to-end slice
+(BASELINE config #1; SURVEY.md §7 stage 5).
+
+Submit txs -> TransactionQueue -> trigger -> TxSetFrame -> SCP (self
+quorum) -> externalize -> closeLedger -> state/bucket hashes advance.
+"""
+import pytest
+
+from stellar_core_tpu.crypto import SecretKey, sha256
+from stellar_core_tpu.herder.tx_queue import TransactionQueue
+from stellar_core_tpu.ledger import LedgerTxn
+from stellar_core_tpu.main import Application, test_config
+from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+from stellar_core_tpu.xdr import types as T
+
+from tests.txtest import TestAccount
+
+
+@pytest.fixture()
+def app():
+    a = Application(VirtualClock(ClockMode.VIRTUAL_TIME), test_config())
+    a.start()
+    return a
+
+
+class NodeAccount(TestAccount):
+    """TestAccount bound to an Application's ledger root."""
+
+    def __init__(self, app, secret):
+        self.app = app
+        self.secret = secret
+        self.account_id = secret.public_key().raw
+
+    @property
+    def ledger(self):
+        class _L:
+            root_txn = self.app.ledger_manager.root
+        return _L()
+
+
+def root_account(app) -> NodeAccount:
+    return NodeAccount(app, SecretKey(app.config.network_id()))
+
+
+def test_boot_creates_genesis(app):
+    info = app.get_json_info()
+    assert info["ledger"]["num"] == 1
+    assert info["state"] == "Synced!"
+
+
+def test_manual_close_advances_empty_ledgers(app):
+    h0 = app.ledger_manager.last_closed_hash()
+    assert app.herder.manual_close() == 2
+    assert app.herder.manual_close() == 3
+    assert app.ledger_manager.last_closed_hash() != h0
+    # header chain links correctly
+    hdr = app.ledger_manager.last_closed_header()
+    assert hdr.ledgerSeq == 3
+
+
+def test_submit_and_close_payment(app):
+    root = root_account(app)
+    dest = SecretKey(sha256(b"node-dest"))
+    env = root.tx([root.op_create_account(
+        dest.public_key().raw, 10**9)])
+    res = app.herder.recv_transaction(env)
+    assert res == TransactionQueue.ADD_STATUS_PENDING
+    assert app.herder.tx_queue.size() == 1
+
+    app.herder.manual_close()
+    # tx applied: destination exists with the balance
+    with LedgerTxn(app.ledger_manager.root) as ltx:
+        e = ltx.load_account(dest.public_key().raw)
+        ltx.rollback()
+    assert e is not None
+    assert e.data.value.balance == 10**9
+    # queue drained post close
+    assert app.herder.tx_queue.size() == 0
+
+
+def test_duplicate_submission_rejected(app):
+    root = root_account(app)
+    dest = SecretKey(sha256(b"node-dup")).public_key().raw
+    env = root.tx([root.op_create_account(dest, 10**9)])
+    assert app.herder.recv_transaction(env) == \
+        TransactionQueue.ADD_STATUS_PENDING
+    assert app.herder.recv_transaction(env) == \
+        TransactionQueue.ADD_STATUS_DUPLICATE
+
+
+def test_seq_gap_try_again_later(app):
+    root = root_account(app)
+    dest = SecretKey(sha256(b"node-gap")).public_key().raw
+    env = root.tx([root.op_create_account(dest, 10**9)],
+                  seq=root.next_seq() + 5)
+    assert app.herder.recv_transaction(env) == \
+        TransactionQueue.ADD_STATUS_TRY_AGAIN_LATER
+
+
+def test_chained_txs_one_ledger(app):
+    root = root_account(app)
+    a = SecretKey(sha256(b"chain-a"))
+    b = SecretKey(sha256(b"chain-b"))
+    seq = root.next_seq()
+    env1 = root.tx([root.op_create_account(a.public_key().raw, 10**9)],
+                   seq=seq)
+    env2 = root.tx([root.op_create_account(b.public_key().raw, 10**9)],
+                   seq=seq + 1)
+    assert app.herder.recv_transaction(env1) == 0
+    assert app.herder.recv_transaction(env2) == 0
+    app.herder.manual_close()
+    with LedgerTxn(app.ledger_manager.root) as ltx:
+        assert ltx.load_account(a.public_key().raw) is not None
+        assert ltx.load_account(b.public_key().raw) is not None
+        ltx.rollback()
+
+
+def test_bucket_list_hash_advances_and_is_deterministic():
+    def run():
+        app = Application(
+            VirtualClock(ClockMode.VIRTUAL_TIME), test_config())
+        app.start()
+        root = root_account(app)
+        dest = SecretKey(sha256(b"det-dest")).public_key().raw
+        env = root.tx([root.op_create_account(dest, 10**9)])
+        app.herder.recv_transaction(env)
+        app.herder.manual_close()
+        return (app.ledger_manager.last_closed_hash(),
+                app.bucket_manager.get_bucket_list_hash())
+
+    h1, b1 = run()
+    h2, b2 = run()
+    assert h1 == h2 and b1 == b2
+    assert b1 != b"\x00" * 32
+    # header carries the bucket hash
+    # (fresh app for state inspection)
+
+
+def test_tx_history_rows_written(app):
+    root = root_account(app)
+    dest = SecretKey(sha256(b"hist-dest")).public_key().raw
+    env = root.tx([root.op_create_account(dest, 10**9)])
+    app.herder.recv_transaction(env)
+    app.herder.manual_close()
+    rows = app.database.execute(
+        "SELECT ledgerseq, txindex FROM txhistory").fetchall()
+    assert len(rows) == 1
+    assert rows[0][0] == 2
+
+
+def test_meta_stream_emitted(app):
+    root = root_account(app)
+    dest = SecretKey(sha256(b"meta-dest")).public_key().raw
+    env = root.tx([root.op_create_account(dest, 10**9)])
+    app.herder.recv_transaction(env)
+    app.herder.manual_close()
+    assert len(app._meta_stream) >= 1
+    meta = app._meta_stream[-1].value
+    assert meta.ledgerHeader.header.ledgerSeq == 2
+    assert len(meta.txProcessing) == 1
+    # round-trips through XDR
+    b = T.LedgerCloseMeta.encode(app._meta_stream[-1])
+    assert T.LedgerCloseMeta.decode(b) is not None
+
+
+def test_invariants_run_during_close(app):
+    # the test config enables all invariants; a normal close passes them
+    root = root_account(app)
+    dest = SecretKey(sha256(b"inv-dest")).public_key().raw
+    env = root.tx([root.op_create_account(dest, 10**9)])
+    app.herder.recv_transaction(env)
+    app.herder.manual_close()  # would raise InvariantDoesNotHold on breach
+    assert app.invariants.invariants  # non-empty set actually ran

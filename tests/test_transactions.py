@@ -1,0 +1,242 @@
+"""Transaction frame + op tests (ref models: src/transactions/test/
+{TxEnvelopeTests,PaymentTests,ChangeTrustTests,SetOptionsTests,
+ManageDataTests,BumpSequenceTests,MergeTests}.cpp)."""
+import pytest
+
+from stellar_core_tpu.crypto import SecretKey, sha256
+from stellar_core_tpu.ledger import LedgerTxn
+from stellar_core_tpu.transactions import TransactionFrame
+from stellar_core_tpu.transactions import utils as U
+from stellar_core_tpu.transactions.signature_checker import signature_hint
+from stellar_core_tpu.xdr import types as T
+
+from tests.txtest import (
+    BASE_FEE, BASE_RESERVE, NETWORK_ID, TestAccount, TestLedger,
+)
+
+TC = T.TransactionResultCode
+
+
+@pytest.fixture()
+def ledger():
+    return TestLedger()
+
+
+@pytest.fixture()
+def root(ledger):
+    return ledger.root()
+
+
+def op_result_code(result, i=0):
+    return result.result.value[i].value.value.type
+
+
+def test_create_account_and_payment(root, ledger):
+    a = root.create("alice", 10 * BASE_RESERVE)
+    b = root.create("bob", 10 * BASE_RESERVE)
+    assert a.exists() and b.exists()
+    start_a, start_b = a.balance(), b.balance()
+    env = a.tx([a.op_payment(b.account_id, 1000000)])
+    a.apply(env)
+    assert a.balance() == start_a - 1000000 - BASE_FEE
+    assert b.balance() == start_b + 1000000
+
+
+def test_create_account_already_exists(root):
+    a = root.create("alice", 10 * BASE_RESERVE)
+    env = root.tx([root.op_create_account(a.account_id, 10 * BASE_RESERVE)])
+    ok, result = root.apply(env, expect_success=False)
+    assert not ok
+    assert result.result.type == TC.txFAILED
+    assert op_result_code(result) == \
+        T.CreateAccountResultCode.CREATE_ACCOUNT_ALREADY_EXIST
+
+
+def test_create_account_low_reserve(root):
+    dest = SecretKey(sha256(b"lowres")).public_key().raw
+    env = root.tx([root.op_create_account(dest, 1)])
+    ok, result = root.apply(env, expect_success=False)
+    assert op_result_code(result) == \
+        T.CreateAccountResultCode.CREATE_ACCOUNT_LOW_RESERVE
+
+
+def test_payment_underfunded(root):
+    a = root.create("alice", 3 * BASE_RESERVE)
+    b = root.create("bob", 3 * BASE_RESERVE)
+    env = a.tx([a.op_payment(b.account_id, 10 * BASE_RESERVE)])
+    ok, result = a.apply(env, expect_success=False)
+    assert op_result_code(result) == \
+        T.PaymentResultCode.PAYMENT_UNDERFUNDED
+
+
+def test_payment_no_destination(root):
+    a = root.create("alice", 10 * BASE_RESERVE)
+    ghost = SecretKey(sha256(b"ghost")).public_key().raw
+    env = a.tx([a.op_payment(ghost, 100)])
+    ok, result = a.apply(env, expect_success=False)
+    assert op_result_code(result) == \
+        T.PaymentResultCode.PAYMENT_NO_DESTINATION
+
+
+def test_seqnum_progression_and_bad_seq(root):
+    a = root.create("alice", 100 * BASE_RESERVE)
+    assert a.loaded_seq() == 0
+    a.apply(a.tx([a.op_bump_seq(0)]))  # no-op bump
+    assert a.loaded_seq() == 1
+    # replay same seq -> bad seq at checkValid
+    env = a.tx([a.op_bump_seq(0)], seq=1)
+    res = a.check_valid(env)
+    assert res.code == TC.txBAD_SEQ
+
+
+def test_check_valid_rejects_insufficient_fee(root):
+    a = root.create("alice", 100 * BASE_RESERVE)
+    env = a.tx([a.op_bump_seq(0)], fee=BASE_FEE - 1)
+    assert a.check_valid(env).code == TC.txINSUFFICIENT_FEE
+
+
+def test_check_valid_rejects_bad_signature(root, ledger):
+    a = root.create("alice", 100 * BASE_RESERVE)
+    mallory = SecretKey(sha256(b"mallory"))
+    env = a.tx([a.op_bump_seq(0)])
+    # replace the signature with mallory's
+    bad = TestAccount(ledger, mallory)
+    env2 = bad.tx([a.op_bump_seq(0)])
+    env_tampered = T.TransactionEnvelope.make(
+        T.EnvelopeType.ENVELOPE_TYPE_TX,
+        T.TransactionV1Envelope.make(
+            tx=env.value.tx, signatures=env2.value.signatures))
+    assert a.check_valid(env_tampered).code == TC.txBAD_AUTH
+
+
+def test_check_valid_rejects_unused_extra_signature(root, ledger):
+    a = root.create("alice", 100 * BASE_RESERVE)
+    stranger = SecretKey(sha256(b"stranger"))
+    env = a.tx([a.op_bump_seq(0)], extra_signers=[stranger])
+    assert a.check_valid(env).code == TC.txBAD_AUTH_EXTRA
+
+
+def test_time_bounds(root):
+    a = root.create("alice", 100 * BASE_RESERVE)
+    close_time = root.ledger.header().scpValue.closeTime
+    tb = T.TimeBounds.make(minTime=close_time + 100, maxTime=0)
+    cond = T.Preconditions.make(T.PreconditionType.PRECOND_TIME, tb)
+    env = a.tx([a.op_bump_seq(0)], cond=cond)
+    assert a.check_valid(env).code == TC.txTOO_EARLY
+    tb2 = T.TimeBounds.make(minTime=0, maxTime=max(1, close_time - 100))
+    cond2 = T.Preconditions.make(T.PreconditionType.PRECOND_TIME, tb2)
+    env2 = a.tx([a.op_bump_seq(0)], cond=cond2)
+    assert a.check_valid(env2).code == TC.txTOO_LATE
+
+
+def test_fee_charged_and_fee_pool(root, ledger):
+    a = root.create("alice", 100 * BASE_RESERVE)
+    pool_before = ledger.header().feePool
+    a.apply(a.tx([a.op_bump_seq(0)]))
+    assert ledger.header().feePool == pool_before + BASE_FEE
+
+
+def test_trustline_payment_flow(root):
+    issuer = root.create("issuer", 100 * BASE_RESERVE)
+    alice = root.create("alice2", 100 * BASE_RESERVE)
+    usd = U.make_asset(b"USD", issuer.account_id)
+    alice.apply(alice.tx([alice.op_change_trust(usd)]))
+    # issuer pays alice 500 USD (issuing)
+    issuer.apply(issuer.tx([issuer.op_payment(
+        alice.account_id, 500, asset=usd)]))
+    # alice pays back 200
+    alice.apply(alice.tx([alice.op_payment(
+        issuer.account_id, 200, asset=usd)]))
+    with LedgerTxn(root.ledger.root_txn) as ltx:
+        tl = ltx.load_trustline(alice.account_id, usd)
+        ltx.rollback()
+    assert tl.data.value.balance == 300
+
+
+def test_payment_no_trust(root):
+    issuer = root.create("issuer", 100 * BASE_RESERVE)
+    alice = root.create("alice3", 100 * BASE_RESERVE)
+    usd = U.make_asset(b"USD", issuer.account_id)
+    env = issuer.tx([issuer.op_payment(alice.account_id, 500, asset=usd)])
+    ok, result = issuer.apply(env, expect_success=False)
+    assert op_result_code(result) == T.PaymentResultCode.PAYMENT_NO_TRUST
+
+
+def test_change_trust_delete(root):
+    issuer = root.create("issuer", 100 * BASE_RESERVE)
+    alice = root.create("alice4", 100 * BASE_RESERVE)
+    usd = U.make_asset(b"USD", issuer.account_id)
+    alice.apply(alice.tx([alice.op_change_trust(usd)]))
+    sub_before = _subentries(root, alice)
+    alice.apply(alice.tx([alice.op_change_trust(usd, limit=0)]))
+    assert _subentries(root, alice) == sub_before - 1
+
+
+def _subentries(root, who):
+    with LedgerTxn(root.ledger.root_txn) as ltx:
+        e = ltx.load_account(who.account_id)
+        ltx.rollback()
+    return e.data.value.numSubEntries
+
+
+def test_manage_data_create_update_delete(root):
+    a = root.create("alice5", 100 * BASE_RESERVE)
+    a.apply(a.tx([a.op_manage_data(b"k1", b"v1")]))
+    assert _subentries(root, a) == 1
+    a.apply(a.tx([a.op_manage_data(b"k1", b"v2")]))
+    with LedgerTxn(root.ledger.root_txn) as ltx:
+        d = ltx.load_data(a.account_id, b"k1")
+        ltx.rollback()
+    assert d.data.value.dataValue == b"v2"
+    a.apply(a.tx([a.op_manage_data(b"k1", None)]))
+    assert _subentries(root, a) == 0
+
+
+def test_set_options_add_signer_multisig(root, ledger):
+    a = root.create("alice6", 100 * BASE_RESERVE)
+    cosigner = SecretKey(sha256(b"cosigner"))
+    signer = T.Signer.make(
+        key=T.SignerKey.make(T.SignerKeyType.SIGNER_KEY_TYPE_ED25519,
+                             cosigner.public_key().raw),
+        weight=1)
+    a.apply(a.tx([a.op_set_options(signer=signer, low=1, med=2, high=2,
+                                   master_weight=1)]))
+    # now a alone passes the tx-level LOW check but fails the payment op's
+    # MED threshold -> txFAILED with opBAD_AUTH (tx-level weight shortfall
+    # would instead be txBAD_AUTH, tested separately)
+    b = root.create("bob6", 100 * BASE_RESERVE)
+    env = a.tx([a.op_payment(b.account_id, 1000)])
+    assert a.check_valid(env).code == TC.txFAILED
+    # with the cosigner it passes
+    env2 = a.tx([a.op_payment(b.account_id, 1000)],
+                extra_signers=[cosigner])
+    assert a.check_valid(env2).ok
+    a.apply(env2)
+
+
+def test_account_merge(root):
+    a = root.create("alice7", 100 * BASE_RESERVE)
+    b = root.create("bob7", 100 * BASE_RESERVE)
+    bal_a, bal_b = a.balance(), b.balance()
+    env = a.tx([a.op_merge(b.account_id)])
+    ok, result = a.apply(env)
+    assert not a.exists()
+    assert b.balance() == bal_b + bal_a - BASE_FEE
+
+
+def test_all_or_nothing_apply(root):
+    a = root.create("alice8", 100 * BASE_RESERVE)
+    b = root.create("bob8", 100 * BASE_RESERVE)
+    bal_b = b.balance()
+    ghost = SecretKey(sha256(b"ghost8")).public_key().raw
+    # first op succeeds, second fails -> nothing applied
+    env = a.tx([
+        a.op_payment(b.account_id, 1000),
+        a.op_payment(ghost, 1000),
+    ])
+    ok, result = a.apply(env, expect_success=False)
+    assert not ok
+    assert result.result.type == TC.txFAILED
+    assert b.balance() == bal_b  # rolled back
+    # fee still charged, seq still bumped
+    assert a.loaded_seq() == 1

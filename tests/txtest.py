@@ -1,0 +1,210 @@
+"""Domain test fixtures: TestAccount + tx builders
+(ref model: src/test/TestAccount.h, TxTests.cpp op builders)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from stellar_core_tpu.crypto import SecretKey, sha256
+from stellar_core_tpu.ledger import LedgerTxn, LedgerTxnRoot, open_database
+from stellar_core_tpu.transactions import utils as U
+from stellar_core_tpu.transactions.signature_checker import signature_hint
+from stellar_core_tpu.xdr import types as T
+
+NETWORK_PASSPHRASE = b"Test SDF Network ; September 2015"
+NETWORK_ID = sha256(NETWORK_PASSPHRASE)
+
+BASE_FEE = 100
+BASE_RESERVE = 5000000
+GENESIS_BALANCE = 10**17  # ~10B XLM in stroops
+
+
+def genesis_header(ledger_seq=1, close_time=1000):
+    sv = T.StellarValue.make(
+        txSetHash=b"\x00" * 32, closeTime=close_time, upgrades=[],
+        ext=T.StellarValue.fields[3][1].make(
+            T.StellarValueType.STELLAR_VALUE_BASIC))
+    return T.LedgerHeader.make(
+        ledgerVersion=19,
+        previousLedgerHash=b"\x00" * 32,
+        scpValue=sv,
+        txSetResultHash=b"\x00" * 32,
+        bucketListHash=b"\x00" * 32,
+        ledgerSeq=ledger_seq,
+        totalCoins=10**18,
+        feePool=0,
+        inflationSeq=0,
+        idPool=0,
+        baseFee=BASE_FEE,
+        baseReserve=BASE_RESERVE,
+        maxTxSetSize=100,
+        skipList=[b"\x00" * 32] * 4,
+        ext=T.LedgerHeader.fields[14][1].make(0),
+    )
+
+
+class TestLedger:
+    """In-memory root + genesis account."""
+
+    def __init__(self):
+        self.db = open_database(":memory:")
+        self.root_txn = LedgerTxnRoot(self.db)
+        self.root_key = SecretKey(sha256(b"genesis-root"))
+        hdr = genesis_header()
+        with LedgerTxn(self.root_txn) as ltx:
+            ltx.set_header(hdr)
+            # bootstrap: write header first so put() can stamp seq
+            ltx.commit()
+        with LedgerTxn(self.root_txn) as ltx:
+            ltx.put(U.make_account_entry(
+                self.root().account_id, GENESIS_BALANCE, seq_num=0))
+            ltx.commit()
+
+    def root(self) -> "TestAccount":
+        return TestAccount(self, self.root_key)
+
+    def header(self):
+        return self.root_txn.header()
+
+
+class TestAccount:
+    """Keypair + auto seq-num bookkeeping (ref TestAccount)."""
+
+    def __init__(self, ledger: TestLedger, secret: SecretKey):
+        self.ledger = ledger
+        self.secret = secret
+        self.account_id = secret.public_key().raw
+
+    @classmethod
+    def from_name(cls, ledger: TestLedger, name: str) -> "TestAccount":
+        return cls(ledger, SecretKey(sha256(name.encode())))
+
+    def loaded_seq(self) -> int:
+        with LedgerTxn(self.ledger.root_txn) as ltx:
+            e = ltx.load_account(self.account_id)
+            ltx.rollback()
+        return e.data.value.seqNum if e is not None else 0
+
+    def next_seq(self) -> int:
+        return self.loaded_seq() + 1
+
+    # -- op builders (ref TxTests.cpp) -------------------------------------
+
+    def op(self, body_type, body_value=None, source=None):
+        return T.Operation.make(
+            sourceAccount=(None if source is None
+                           else T.muxed_account(source)),
+            body=T.OperationBody.make(body_type, body_value))
+
+    def op_create_account(self, dest: bytes, balance: int):
+        return self.op(T.OperationType.CREATE_ACCOUNT,
+                       T.CreateAccountOp.make(
+                           destination=T.account_id(dest),
+                           startingBalance=balance))
+
+    def op_payment(self, dest: bytes, amount: int, asset=None):
+        return self.op(T.OperationType.PAYMENT, T.PaymentOp.make(
+            destination=T.muxed_account(dest),
+            asset=asset or U.asset_native(),
+            amount=amount))
+
+    def op_change_trust(self, asset, limit=U.INT64_MAX):
+        return self.op(T.OperationType.CHANGE_TRUST, T.ChangeTrustOp.make(
+            line=T.ChangeTrustAsset.make(asset.type, asset.value),
+            limit=limit))
+
+    def op_bump_seq(self, to: int):
+        return self.op(T.OperationType.BUMP_SEQUENCE,
+                       T.BumpSequenceOp.make(bumpTo=to))
+
+    def op_manage_data(self, name: bytes, value: Optional[bytes]):
+        return self.op(T.OperationType.MANAGE_DATA, T.ManageDataOp.make(
+            dataName=name, dataValue=value))
+
+    def op_set_options(self, **kw):
+        return self.op(T.OperationType.SET_OPTIONS, T.SetOptionsOp.make(
+            inflationDest=kw.get("inflation_dest"),
+            clearFlags=kw.get("clear_flags"),
+            setFlags=kw.get("set_flags"),
+            masterWeight=kw.get("master_weight"),
+            lowThreshold=kw.get("low"),
+            medThreshold=kw.get("med"),
+            highThreshold=kw.get("high"),
+            homeDomain=kw.get("home_domain"),
+            signer=kw.get("signer")))
+
+    def op_merge(self, dest: bytes):
+        return self.op(T.OperationType.ACCOUNT_MERGE,
+                       T.muxed_account(dest))
+
+    # -- tx builder ---------------------------------------------------------
+
+    def tx(self, ops: List, fee: Optional[int] = None,
+           seq: Optional[int] = None, cond=None,
+           extra_signers: List[SecretKey] = ()):
+        tx = T.Transaction.make(
+            sourceAccount=T.muxed_account(self.account_id),
+            fee=fee if fee is not None else BASE_FEE * len(ops),
+            seqNum=seq if seq is not None else self.next_seq(),
+            cond=cond or T.Preconditions.make(
+                T.PreconditionType.PRECOND_NONE),
+            memo=T.MEMO_NONE_VALUE,
+            operations=ops,
+            ext=T.Transaction.fields[6][1].make(0),
+        )
+        payload = T.TransactionSignaturePayload.make(
+            networkId=NETWORK_ID,
+            taggedTransaction=T.TransactionSignaturePayload.fields[1][1]
+            .make(T.EnvelopeType.ENVELOPE_TYPE_TX, tx))
+        h = sha256(T.TransactionSignaturePayload.encode(payload))
+        sigs = []
+        for sk in [self.secret, *extra_signers]:
+            sigs.append(T.DecoratedSignature.make(
+                hint=signature_hint(sk.public_key().raw),
+                signature=sk.sign(h)))
+        return T.TransactionEnvelope.make(
+            T.EnvelopeType.ENVELOPE_TYPE_TX,
+            T.TransactionV1Envelope.make(tx=tx, signatures=sigs))
+
+    # -- execution helpers ---------------------------------------------------
+
+    def apply(self, env, expect_success=True):
+        """processFeeSeqNum + apply against the root, like one-tx ledger
+        close; returns (ok, result)."""
+        from stellar_core_tpu.transactions import TransactionFrame
+
+        frame = TransactionFrame(NETWORK_ID, env)
+        with LedgerTxn(self.ledger.root_txn) as ltx:
+            frame.process_fee_seq_num(ltx, base_fee=BASE_FEE)
+            ok, result, meta = frame.apply(ltx)
+            ltx.commit()
+        if expect_success:
+            assert ok, result
+        return ok, result
+
+    def check_valid(self, env):
+        from stellar_core_tpu.transactions import TransactionFrame
+
+        frame = TransactionFrame(NETWORK_ID, env)
+        with LedgerTxn(self.ledger.root_txn) as ltx:
+            res = frame.check_valid(ltx)
+            ltx.rollback()
+        return res
+
+    def balance(self) -> int:
+        with LedgerTxn(self.ledger.root_txn) as ltx:
+            e = ltx.load_account(self.account_id)
+            ltx.rollback()
+        return e.data.value.balance if e is not None else -1
+
+    def exists(self) -> bool:
+        with LedgerTxn(self.ledger.root_txn) as ltx:
+            e = ltx.load_account(self.account_id)
+            ltx.rollback()
+        return e is not None
+
+    def create(self, name: str, balance: int) -> "TestAccount":
+        """Create a funded child account."""
+        child = TestAccount.from_name(self.ledger, name)
+        env = self.tx([self.op_create_account(child.account_id, balance)])
+        self.apply(env)
+        return child
