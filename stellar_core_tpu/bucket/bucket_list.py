@@ -44,13 +44,21 @@ def level_should_spill(ledger_seq: int, level: int) -> bool:
 class Bucket:
     """Immutable sorted run of (key, BucketEntry)."""
 
-    __slots__ = ("entries", "_hash")
+    __slots__ = ("entries", "_hash", "_keys")
 
     EMPTY_HASH = b"\x00" * 32
 
     def __init__(self, entries: Sequence[Tuple[bytes, object]] = ()):
         self.entries = tuple(entries)
         self._hash: Optional[bytes] = None
+        self._keys: Optional[Tuple[bytes, ...]] = None
+
+    @property
+    def keys(self) -> Tuple[bytes, ...]:
+        # cached: immutable; rebuilt key lists made lookups O(n)
+        if self._keys is None:
+            self._keys = tuple(k for k, _ in self.entries)
+        return self._keys
 
     def is_empty(self) -> bool:
         return not self.entries
@@ -118,13 +126,18 @@ def _merge_entry(new, old):
     (ref Bucket::mergeCasesWithEqualKeys):
     - DEAD over INIT -> annihilate (entry never existed at this level)
     - DEAD over LIVE/DEAD -> DEAD
-    - LIVE over INIT -> INIT with the new value (still 'created here')
+    - LIVE/INIT over INIT -> INIT with the new value (still 'created here')
+    - INIT over DEAD -> LIVE (delete + recreate = net update: the INIT must
+      NOT survive or a later DEAD would annihilate it and resurrect the
+      original entry from a deeper level)
     - otherwise keep the newer."""
     nt, ot = new.type, old.type
     if nt == BET.DEADENTRY and ot == BET.INITENTRY:
         return None
     if nt in (BET.LIVEENTRY, BET.INITENTRY) and ot == BET.INITENTRY:
         return T.BucketEntry.make(BET.INITENTRY, new.value)
+    if nt == BET.INITENTRY and ot == BET.DEADENTRY:
+        return T.BucketEntry.make(BET.LIVEENTRY, new.value)
     return new
 
 
@@ -198,12 +211,12 @@ class BucketList:
 
 
 def _bucket_find(bucket: Bucket, kb: bytes):
-    """Binary search by key."""
+    """Binary search by key (cached keys tuple)."""
     import bisect
 
-    keys = [k for k, _ in bucket.entries]
+    keys = bucket.keys
     i = bisect.bisect_left(keys, kb)
-    if i < len(bucket.entries) and bucket.entries[i][0] == kb:
+    if i < len(keys) and keys[i] == kb:
         return bucket.entries[i][1]
     return None
 

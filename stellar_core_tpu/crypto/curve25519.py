@@ -1,0 +1,87 @@
+"""X25519 ECDH + overlay key derivation (ref src/crypto/Curve25519.h:45,
+src/overlay/PeerAuth.cpp: ECDH shared key -> HKDF -> per-direction
+HMAC-SHA256 session keys).
+
+Pure-python Montgomery ladder over GF(2^255-19) (host-side, handshake-rate
+only — not a hot path; the batched device kernels are for ed25519 verify).
+RFC 7748 semantics.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Tuple
+
+P = 2**255 - 19
+A24 = 121665
+
+
+def _clamp(k: bytes) -> int:
+    n = bytearray(k)
+    n[0] &= 248
+    n[31] &= 127
+    n[31] |= 64
+    return int.from_bytes(bytes(n), "little")
+
+
+def x25519(scalar: bytes, u_point: bytes) -> bytes:
+    """RFC 7748 X25519: scalar (32B) * u (32B) -> u' (32B)."""
+    k = _clamp(scalar)
+    u = int.from_bytes(u_point, "little") & (2**255 - 1)
+
+    x1, x2, z2, x3, z3 = u, 1, 0, u, 1
+    swap = 0
+    for t in range(254, -1, -1):
+        kt = (k >> t) & 1
+        swap ^= kt
+        if swap:
+            x2, x3 = x3, x2
+            z2, z3 = z3, z2
+        swap = kt
+        a = (x2 + z2) % P
+        aa = a * a % P
+        b = (x2 - z2) % P
+        bb = b * b % P
+        e = (aa - bb) % P
+        c = (x3 + z3) % P
+        d = (x3 - z3) % P
+        da = d * a % P
+        cb = c * b % P
+        x3 = (da + cb) % P
+        x3 = x3 * x3 % P
+        z3 = (da - cb) % P
+        z3 = x1 * z3 * z3 % P
+        x2 = aa * bb % P
+        z2 = e * (aa + A24 * e) % P
+    if swap:
+        x2, x3 = x3, x2
+        z2, z3 = z3, z2
+    out = x2 * pow(z2, P - 2, P) % P
+    return out.to_bytes(32, "little")
+
+
+BASE_POINT = (9).to_bytes(32, "little")
+
+
+def curve25519_random_secret(seed: bytes) -> bytes:
+    """Deterministic secret from seed material (tests/handshakes)."""
+    return hashlib.sha256(b"curve25519" + seed).digest()
+
+
+def curve25519_public(secret: bytes) -> bytes:
+    return x25519(secret, BASE_POINT)
+
+
+def curve25519_derive_shared(secret: bytes, local_pub: bytes,
+                             remote_pub: bytes, we_called: bool) -> bytes:
+    """ECDH + role-ordered pubkeys -> HKDF-extract, mirroring the
+    reference's curve25519DeriveSharedKey: the raw ECDH secret is salted
+    with both public keys in (caller, callee) order so both sides derive
+    the same key (ref PeerAuth::getSharedKey :73)."""
+    q = x25519(secret, remote_pub)
+    if we_called:
+        buf = q + local_pub + remote_pub
+    else:
+        buf = q + remote_pub + local_pub
+    from .sha import hkdf_extract
+
+    return hkdf_extract(buf)

@@ -56,6 +56,9 @@ class TransactionQueue:
 
         src = frame.source_account_id()
         acct = self.accounts.get(src)
+        # cheap capacity check BEFORE the expensive validity/signature work
+        if acct is not None and len(acct.frames) >= self.MAX_PER_ACCOUNT:
+            return self.ADD_STATUS_TRY_AGAIN_LATER
         lm = self.app.ledger_manager
 
         # seq continuity: must extend the chain (account seq + queued txs)
@@ -79,8 +82,6 @@ class TransactionQueue:
 
         if acct is None:
             acct = self.accounts[src] = AccountTxs()
-        if len(acct.frames) >= self.MAX_PER_ACCOUNT:
-            return self.ADD_STATUS_TRY_AGAIN_LATER
         acct.frames.append(frame)
         self.known[h] = frame
         self.app.metrics.counter("herder.pending-txs.count").inc()

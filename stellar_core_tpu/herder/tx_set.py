@@ -91,10 +91,15 @@ class TxSetFrame:
 
     def check_valid(self, ltx_root, lcl_hash: bytes,
                     verify=None) -> bool:
-        """ref TxSetFrame::checkValid :562 — prev-hash linkage, hash order,
-        per-source seq continuity, per-tx checkValid."""
+        """ref TxSetFrame::checkValid :562 — prev-hash linkage, size cap,
+        hash order, per-source seq continuity, per-tx checkValid."""
         if self.previous_ledger_hash != lcl_hash:
             return False
+        with LedgerTxn(ltx_root) as _hltx:
+            max_ops = _hltx.header().maxTxSetSize
+            _hltx.rollback()
+        if self.size_op() > max_ops:
+            return False  # oversized set: reject like the reference
         hashes = [f.full_hash() for f in self.frames]
         if hashes != sorted(hashes):
             return False

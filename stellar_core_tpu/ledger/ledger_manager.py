@@ -158,14 +158,16 @@ class LedgerManager:
             with self.metrics.timer(
                     "ledger.transaction.apply").time_scope():
                 for i, frame in enumerate(apply_order):
-                    ok, result, meta = frame.apply(ltx, verify=verify)
+                    ok, result, meta = frame.apply(
+                        ltx, verify=verify,
+                        invariant_check=self.app.invariants
+                        .check_on_tx_apply)
                     pair = frame.result_pair(result)
                     result_pairs.append(pair)
                     tx_result_metas.append(T.TransactionResultMeta.make(
                         result=pair,
                         feeProcessing=fee_changes[i],
                         txApplyProcessing=meta))
-                    self.app.invariants.check_on_tx_apply(ltx, frame, ok)
 
             # phase 3: upgrades (ref :786-830)
             upgrade_metas: List[object] = []

@@ -1,0 +1,5 @@
+"""Overlay p2p network (ref src/overlay — SURVEY.md §2.3)."""
+from .manager import Floodgate, OverlayManager  # noqa: F401
+from .peer import (  # noqa: F401
+    LoopbackPeer, Peer, PeerRole, PeerState, make_loopback_pair,
+)

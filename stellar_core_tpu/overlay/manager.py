@@ -1,0 +1,249 @@
+"""OverlayManager: connection lifecycle, flood fan-out, item fetching
+(ref src/overlay/OverlayManagerImpl.cpp, Floodgate.cpp, ItemFetcher.h —
+SURVEY.md §2.3).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from ..crypto import sha256
+from ..xdr import overlay_types as O
+from ..xdr import types as T
+
+FLOOD_RECORD_TTL_LEDGERS = 10
+
+
+class Floodgate:
+    """Dedup + fan-out of flood messages; remembers which peer already has
+    what (ref Floodgate.cpp:61-120)."""
+
+    def __init__(self):
+        # msg hash -> {"peers": set of peer_ids that have it, "seq": ledger}
+        self.records: Dict[bytes, dict] = {}
+
+    @staticmethod
+    def msg_id(msg) -> bytes:
+        return sha256(O.StellarMessage.encode(msg))
+
+    def add_record(self, msg, from_peer_id: Optional[bytes],
+                   ledger_seq: int) -> bool:
+        """Returns True if the message is NEW (should be processed +
+        forwarded)."""
+        h = self.msg_id(msg)
+        rec = self.records.get(h)
+        if rec is None:
+            rec = self.records[h] = {"peers": set(), "seq": ledger_seq}
+            if from_peer_id is not None:
+                rec["peers"].add(from_peer_id)
+            return True
+        if from_peer_id is not None:
+            rec["peers"].add(from_peer_id)
+        return False
+
+    def peers_to_send(self, msg, authenticated_peers) -> List:
+        h = self.msg_id(msg)
+        rec = self.records.setdefault(
+            h, {"peers": set(), "seq": 0})
+        out = [p for p in authenticated_peers
+               if p.peer_id not in rec["peers"]]
+        for p in out:
+            rec["peers"].add(p.peer_id)
+        return out
+
+    def clear_below(self, ledger_seq: int) -> None:
+        cutoff = ledger_seq - FLOOD_RECORD_TTL_LEDGERS
+        for h in [h for h, r in self.records.items() if r["seq"] < cutoff]:
+            del self.records[h]
+
+
+class ItemTracker:
+    """Tracks one missing item being fetched (ref Tracker.h:40)."""
+
+    def __init__(self, item_hash: bytes, item_type: int):
+        self.item_hash = item_hash
+        self.item_type = item_type  # GET_TX_SET or GET_SCP_QUORUMSET
+        self.asked: Set[bytes] = set()
+        self.dont_have: Set[bytes] = set()
+
+
+class OverlayManager:
+    def __init__(self, app):
+        self.app = app
+        self.pending_peers: List = []
+        self.authenticated: Dict[bytes, object] = {}
+        self.floodgate = Floodgate()
+        self.trackers: Dict[bytes, ItemTracker] = {}
+        self.banned_peers: Set[bytes] = set()
+        self._shutting_down = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        pass  # TCP listen/connect wiring lives in tcp_peer.setup
+
+    def shutdown(self) -> None:
+        self._shutting_down = True
+        for p in list(self.authenticated.values()):
+            p.close("shutdown")
+
+    def add_pending_peer(self, peer) -> None:
+        self.pending_peers.append(peer)
+
+    def peer_authenticated(self, peer) -> None:
+        if peer.peer_id in self.banned_peers:
+            peer.close("banned")
+            return
+        if peer in self.pending_peers:
+            self.pending_peers.remove(peer)
+        self.authenticated[peer.peer_id] = peer
+        self.app.metrics.counter("overlay.connection.authenticated").inc()
+
+    def peer_closed(self, peer, reason: str) -> None:
+        if peer in self.pending_peers:
+            self.pending_peers.remove(peer)
+        if peer.peer_id and self.authenticated.get(peer.peer_id) is peer:
+            del self.authenticated[peer.peer_id]
+
+    def connection_count(self) -> int:
+        return len(self.authenticated)
+
+    def ban_peer(self, peer_id: bytes) -> None:
+        self.banned_peers.add(peer_id)
+        p = self.authenticated.get(peer_id)
+        if p is not None:
+            p.close("banned")
+
+    # -- broadcast (the flood network) --------------------------------------
+
+    def _ledger_seq(self) -> int:
+        try:
+            return self.app.ledger_manager.last_closed_seq()
+        except Exception:
+            return 0
+
+    def broadcast_message(self, msg, force: bool = False) -> None:
+        """ref broadcastMessage :1038 — fan out to peers lacking it."""
+        for p in self.floodgate.peers_to_send(
+                msg, list(self.authenticated.values())):
+            p.send_message(msg)
+
+    def broadcast_transaction(self, env) -> None:
+        self.broadcast_message(O.StellarMessage.make(
+            O.MessageType.TRANSACTION, env))
+
+    def broadcast_scp(self, scp_env) -> None:
+        self.broadcast_message(O.StellarMessage.make(
+            O.MessageType.SCP_MESSAGE, scp_env))
+
+    # -- inbound dispatch (called from Peer) --------------------------------
+
+    def recv_transaction(self, peer, env) -> None:
+        msg = O.StellarMessage.make(O.MessageType.TRANSACTION, env)
+        if not self.floodgate.add_record(msg, peer.peer_id,
+                                         self._ledger_seq()):
+            return
+        res = self.app.herder.tx_queue.try_add(env)
+        if res == 0:  # pending: forward
+            self.broadcast_message(msg)
+
+    def recv_scp_message(self, peer, scp_env) -> None:
+        msg = O.StellarMessage.make(O.MessageType.SCP_MESSAGE, scp_env)
+        if not self.floodgate.add_record(msg, peer.peer_id,
+                                         self._ledger_seq()):
+            return
+        self.app.herder.recv_scp_envelope(scp_env)
+        self.broadcast_message(msg)
+
+    def recv_get_tx_set(self, peer, h: bytes) -> None:
+        ts = self.app.herder.pending_envelopes.get_tx_set(h)
+        if ts is not None:
+            peer.send_message(O.StellarMessage.make(
+                O.MessageType.TX_SET, ts.to_xdr()))
+        else:
+            peer.send_message(O.StellarMessage.make(
+                O.MessageType.DONT_HAVE, O.DontHave.make(
+                    type=O.MessageType.GET_TX_SET, reqHash=h)))
+
+    def recv_tx_set(self, peer, xdr_tx_set) -> None:
+        from ..herder.tx_set import TxSetFrame
+
+        ts = TxSetFrame.make_from_wire(
+            self.app.config.network_id(), xdr_tx_set)
+        self.trackers.pop(ts.contents_hash(), None)
+        self.app.herder.recv_tx_set(ts)
+
+    def recv_get_qset(self, peer, h: bytes) -> None:
+        qs = self.app.herder.pending_envelopes.get_qset(h)
+        if qs is not None:
+            peer.send_message(O.StellarMessage.make(
+                O.MessageType.SCP_QUORUMSET, qs))
+        else:
+            peer.send_message(O.StellarMessage.make(
+                O.MessageType.DONT_HAVE, O.DontHave.make(
+                    type=O.MessageType.GET_SCP_QUORUMSET, reqHash=h)))
+
+    def recv_qset(self, peer, qset) -> None:
+        from ..scp.local_node import qset_hash
+
+        self.trackers.pop(qset_hash(qset), None)
+        self.app.herder.recv_qset(qset)
+
+    def recv_get_scp_state(self, peer, ledger_seq: int) -> None:
+        for slot_index in sorted(self.app.herder.scp.slots):
+            for env in self.app.herder.scp.get_latest_messages_send(
+                    slot_index):
+                peer.send_message(O.StellarMessage.make(
+                    O.MessageType.SCP_MESSAGE, env))
+
+    def recv_dont_have(self, peer, dont_have) -> None:
+        tracker = self.trackers.get(dont_have.reqHash)
+        if tracker is not None:
+            tracker.dont_have.add(peer.peer_id)
+            self._ask_next(tracker)
+
+    def recv_get_peers(self, peer) -> None:
+        peer.send_message(O.StellarMessage.make(
+            O.MessageType.PEERS, []))
+
+    def recv_peers(self, peer, addrs) -> None:
+        pass  # address book grows with the TCP transport
+
+    def recv_flood_advert(self, peer, advert) -> None:
+        """Pull-mode tx flooding: demand hashes we don't know
+        (ref TxAdvertQueue.h:21)."""
+        unknown = [h for h in advert.txHashes
+                   if h not in self.app.herder.tx_queue.known]
+        if unknown:
+            peer.send_message(O.StellarMessage.make(
+                O.MessageType.FLOOD_DEMAND,
+                O.FloodDemand.make(txHashes=unknown)))
+
+    def recv_flood_demand(self, peer, demand) -> None:
+        for h in demand.txHashes:
+            frame = self.app.herder.tx_queue.known.get(h)
+            if frame is not None:
+                peer.send_message(O.StellarMessage.make(
+                    O.MessageType.TRANSACTION, frame.envelope))
+
+    # -- anycast item fetch (ref ItemFetcher.h:54) ---------------------------
+
+    def fetch_items(self, hashes: List[bytes]) -> None:
+        for h in hashes:
+            if h in self.trackers:
+                continue
+            # guess the type by asking for both; a txset-hash answered by
+            # DONT_HAVE for one type will be retried as the other
+            tracker = ItemTracker(h, O.MessageType.GET_TX_SET)
+            self.trackers[h] = tracker
+            self._ask_next(tracker)
+
+    def _ask_next(self, tracker: ItemTracker) -> None:
+        for p in self.authenticated.values():
+            if p.peer_id in tracker.asked:
+                continue
+            tracker.asked.add(p.peer_id)
+            p.send_message(O.StellarMessage.make(
+                O.MessageType.GET_TX_SET, tracker.item_hash))
+            p.send_message(O.StellarMessage.make(
+                O.MessageType.GET_SCP_QUORUMSET, tracker.item_hash))
+            return

@@ -1,0 +1,402 @@
+"""Peer: per-connection protocol — HELLO/AUTH handshake, per-direction
+HMAC-SHA256 message authentication, flow control, dispatch
+(ref src/overlay/Peer.cpp, PeerAuth.cpp, FlowControl.h — SURVEY.md §2.3).
+
+Transport-agnostic: ``LoopbackPeer`` pairs deliver through in-memory queues
+on the shared VirtualClock (the Simulation path, ref
+src/overlay/test/LoopbackPeer.h); ``TCPPeer`` (tcp_peer.py) speaks
+length-prefixed XDR frames over sockets.
+"""
+from __future__ import annotations
+
+import os
+from enum import Enum
+from typing import Callable, List, Optional
+
+from ..crypto import hkdf_expand, hmac_sha256, sha256
+from ..crypto.curve25519 import (
+    curve25519_derive_shared, curve25519_public, curve25519_random_secret,
+)
+from ..xdr import overlay_types as O
+from ..xdr import types as T
+
+OVERLAY_VERSION = 28
+OVERLAY_MIN_VERSION = 27
+AUTH_CERT_LIFETIME = 3600.0  # seconds
+
+# flow control (ref FlowControlCapacity.h defaults)
+PEER_FLOOD_READING_CAPACITY = 200
+FLOW_CONTROL_SEND_MORE_BATCH = 40
+
+FLOOD_TYPES = (O.MessageType.TRANSACTION, O.MessageType.SCP_MESSAGE,
+               O.MessageType.FLOOD_ADVERT, O.MessageType.FLOOD_DEMAND)
+
+
+class PeerState(Enum):
+    CONNECTING = 0
+    CONNECTED = 1
+    GOT_HELLO = 2
+    GOT_AUTH = 3
+    CLOSING = 4
+
+
+class PeerRole(Enum):
+    INITIATOR = 0   # we called remote
+    ACCEPTOR = 1    # remote called us
+
+
+def make_auth_cert(app, auth_secret: bytes):
+    """Curve25519 pub signed by the node identity key
+    (ref PeerAuth::createAuthCert)."""
+    pub = curve25519_public(auth_secret)
+    expiration = int(app.clock.system_now() + AUTH_CERT_LIFETIME)
+    body = (app.config.network_id()
+            + T.EnvelopeType.encode(T.EnvelopeType.ENVELOPE_TYPE_AUTH)
+            + expiration.to_bytes(8, "big") + pub)
+    sig = app.config.node_secret().sign(sha256(body))
+    return O.AuthCert.make(
+        pubkey=T.Curve25519Public.make(key=pub),
+        expiration=expiration,
+        sig=sig)
+
+
+def verify_auth_cert(app, node_id: bytes, cert) -> bool:
+    from ..crypto import verify_sig
+
+    if cert.expiration < app.clock.system_now():
+        return False
+    body = (app.config.network_id()
+            + T.EnvelopeType.encode(T.EnvelopeType.ENVELOPE_TYPE_AUTH)
+            + int(cert.expiration).to_bytes(8, "big") + cert.pubkey.key)
+    return verify_sig(node_id, cert.sig, sha256(body))
+
+
+class Peer:
+    def __init__(self, app, role: PeerRole):
+        self.app = app
+        self.role = role
+        self.state = PeerState.CONNECTED
+        self.peer_id: Optional[bytes] = None
+        self.remote_version: bytes = b""
+        self.remote_listening_port = 0
+        # auth material
+        self.auth_secret = curve25519_random_secret(
+            app.config.node_id() + os.urandom(16))
+        self.auth_nonce = os.urandom(32)
+        self.remote_nonce: Optional[bytes] = None
+        self.remote_auth_pub: Optional[bytes] = None
+        self.send_mac_key = b""
+        self.recv_mac_key = b""
+        self.send_seq = 0
+        self.recv_seq = 0
+        # flow control
+        self.outbound_credit = 0          # flood msgs we may send
+        self.inbound_unacked = 0          # flood msgs received, not credited
+        self.outbound_queue: List[object] = []
+        # stats
+        self.messages_read = 0
+        self.messages_written = 0
+        self.bytes_read = 0
+        self.bytes_written = 0
+
+    # -- transport surface (subclass) ---------------------------------------
+
+    def transport_write(self, data: bytes) -> None:
+        raise NotImplementedError
+
+    def close(self, reason: str = "") -> None:
+        self.state = PeerState.CLOSING
+        self.app.overlay_manager.peer_closed(self, reason)
+
+    # -- handshake ----------------------------------------------------------
+
+    def start_handshake(self) -> None:
+        """Initiator sends HELLO first (ref Peer::connectHandler)."""
+        if self.role == PeerRole.INITIATOR:
+            self._send_hello()
+
+    def _send_hello(self) -> None:
+        cfg = self.app.config
+        hello = O.Hello.make(
+            ledgerVersion=cfg.LEDGER_PROTOCOL_VERSION,
+            overlayVersion=OVERLAY_VERSION,
+            overlayMinVersion=OVERLAY_MIN_VERSION,
+            networkID=cfg.network_id(),
+            versionStr=b"stellar-core-tpu",
+            listeningPort=cfg.PEER_PORT,
+            peerID=T.account_id(cfg.node_id()),
+            cert=make_auth_cert(self.app, self.auth_secret),
+            nonce=self.auth_nonce,
+        )
+        self._send_unauthenticated(
+            O.StellarMessage.make(O.MessageType.HELLO, hello))
+
+    def _send_auth(self) -> None:
+        self.send_message(O.StellarMessage.make(
+            O.MessageType.AUTH, O.Auth.make(
+                flags=O.AUTH_MSG_FLAG_FLOW_CONTROL_BYTES_REQUESTED)))
+
+    def _setup_session_keys(self) -> None:
+        """ECDH -> HKDF per-direction MAC keys (ref PeerAuth::
+        getSendingMacKey/getReceivingMacKey :111-137)."""
+        we_called = self.role == PeerRole.INITIATOR
+        shared = curve25519_derive_shared(
+            self.auth_secret, curve25519_public(self.auth_secret),
+            self.remote_auth_pub, we_called)
+        if we_called:
+            self.send_mac_key = hkdf_expand(
+                shared, b"\x00" + self.auth_nonce + self.remote_nonce)
+            self.recv_mac_key = hkdf_expand(
+                shared, b"\x01" + self.remote_nonce + self.auth_nonce)
+        else:
+            self.send_mac_key = hkdf_expand(
+                shared, b"\x01" + self.auth_nonce + self.remote_nonce)
+            self.recv_mac_key = hkdf_expand(
+                shared, b"\x00" + self.remote_nonce + self.auth_nonce)
+
+    def is_authenticated(self) -> bool:
+        return self.state == PeerState.GOT_AUTH
+
+    # -- sending ------------------------------------------------------------
+
+    def _send_unauthenticated(self, msg) -> None:
+        am = O.AuthenticatedMessage.make(0, O.AuthenticatedMessage.arms[0][1]
+                                         .make(sequence=0, message=msg,
+                                               mac=T.HmacSha256Mac.make(
+                                                   mac=b"\x00" * 32)))
+        data = O.AuthenticatedMessage.encode(am)
+        self.bytes_written += len(data)
+        self.messages_written += 1
+        self.transport_write(data)
+
+    def send_message(self, msg) -> None:
+        """Authenticated + flow-controlled send (ref Peer::sendMessage +
+        FlowControl outbound queues)."""
+        if msg.type in FLOOD_TYPES and self.is_authenticated():
+            if self.outbound_credit <= 0:
+                self.outbound_queue.append(msg)
+                return
+            self.outbound_credit -= 1
+        self._send_now(msg)
+
+    def _send_now(self, msg) -> None:
+        body = O.StellarMessage.encode(msg)
+        mac = hmac_sha256(self.send_mac_key,
+                          self.send_seq.to_bytes(8, "big") + body)
+        am = O.AuthenticatedMessage.make(
+            0, O.AuthenticatedMessage.arms[0][1].make(
+                sequence=self.send_seq, message=msg,
+                mac=T.HmacSha256Mac.make(mac=mac)))
+        self.send_seq += 1
+        data = O.AuthenticatedMessage.encode(am)
+        self.bytes_written += len(data)
+        self.messages_written += 1
+        self.transport_write(data)
+
+    def _flush_outbound(self) -> None:
+        while self.outbound_queue and self.outbound_credit > 0:
+            self.outbound_credit -= 1
+            self._send_now(self.outbound_queue.pop(0))
+
+    # -- receiving ----------------------------------------------------------
+
+    def recv_bytes(self, data: bytes) -> None:
+        self.bytes_read += len(data)
+        try:
+            am = O.AuthenticatedMessage.decode(data)
+        except Exception:
+            self.send_error(O.ErrorCode.ERR_DATA, b"malformed")
+            self.close("malformed message")
+            return
+        v0 = am.value
+        msg = v0.message
+        if self.is_authenticated() or self.state == PeerState.GOT_HELLO:
+            if msg.type not in (O.MessageType.HELLO,
+                                O.MessageType.ERROR_MSG):
+                body = O.StellarMessage.encode(msg)
+                want = hmac_sha256(
+                    self.recv_mac_key,
+                    v0.sequence.to_bytes(8, "big") + body)
+                if v0.mac.mac != want or v0.sequence != self.recv_seq:
+                    self.send_error(O.ErrorCode.ERR_AUTH, b"bad mac/seq")
+                    self.close("mac failure")
+                    return
+                self.recv_seq += 1
+        self.messages_read += 1
+        self.recv_message(msg)
+
+    def recv_message(self, msg) -> None:
+        """Dispatch by type (ref Peer::recvMessage switch :781-1018)."""
+        MT = O.MessageType
+        t = msg.type
+        if t == MT.ERROR_MSG:
+            self.close(f"peer error: {msg.value.msg!r}")
+            return
+        if t == MT.HELLO:
+            self._recv_hello(msg.value)
+            return
+        if t == MT.AUTH:
+            self._recv_auth(msg.value)
+            return
+        if not self.is_authenticated():
+            self.send_error(O.ErrorCode.ERR_AUTH, b"not authenticated")
+            self.close("message before auth")
+            return
+        # flow-control accounting for flood messages
+        if t in FLOOD_TYPES:
+            self.inbound_unacked += 1
+            if self.inbound_unacked >= FLOW_CONTROL_SEND_MORE_BATCH:
+                self.send_message(O.StellarMessage.make(
+                    O.MessageType.SEND_MORE,
+                    O.SendMore.make(numMessages=self.inbound_unacked)))
+                self.inbound_unacked = 0
+        om = self.app.overlay_manager
+        if t == MT.SEND_MORE:
+            self.outbound_credit += msg.value.numMessages
+            self._flush_outbound()
+        elif t == MT.SEND_MORE_EXTENDED:
+            self.outbound_credit += msg.value.numMessages
+            self._flush_outbound()
+        elif t == MT.TRANSACTION:
+            om.recv_transaction(self, msg.value)
+        elif t == MT.SCP_MESSAGE:
+            om.recv_scp_message(self, msg.value)
+        elif t == MT.GET_TX_SET:
+            om.recv_get_tx_set(self, msg.value)
+        elif t == MT.TX_SET:
+            om.recv_tx_set(self, msg.value)
+        elif t == MT.GET_SCP_QUORUMSET:
+            om.recv_get_qset(self, msg.value)
+        elif t == MT.SCP_QUORUMSET:
+            om.recv_qset(self, msg.value)
+        elif t == MT.GET_SCP_STATE:
+            om.recv_get_scp_state(self, msg.value)
+        elif t == MT.DONT_HAVE:
+            om.recv_dont_have(self, msg.value)
+        elif t == MT.GET_PEERS:
+            om.recv_get_peers(self)
+        elif t == MT.PEERS:
+            om.recv_peers(self, msg.value)
+        elif t == MT.FLOOD_ADVERT:
+            om.recv_flood_advert(self, msg.value)
+        elif t == MT.FLOOD_DEMAND:
+            om.recv_flood_demand(self, msg.value)
+
+    def _recv_hello(self, hello) -> None:
+        cfg = self.app.config
+        if hello.networkID != cfg.network_id():
+            self.send_error(O.ErrorCode.ERR_CONF, b"wrong network")
+            self.close("wrong network")
+            return
+        if hello.overlayMinVersion > OVERLAY_VERSION or \
+                hello.overlayVersion < OVERLAY_MIN_VERSION:
+            self.send_error(O.ErrorCode.ERR_CONF, b"version mismatch")
+            self.close("overlay version")
+            return
+        peer_id = hello.peerID.value
+        if peer_id == cfg.node_id():
+            self.send_error(O.ErrorCode.ERR_CONF, b"self connection")
+            self.close("connected to self")
+            return
+        if not verify_auth_cert(self.app, peer_id, hello.cert):
+            self.send_error(O.ErrorCode.ERR_AUTH, b"bad cert")
+            self.close("bad auth cert")
+            return
+        self.peer_id = peer_id
+        self.remote_nonce = hello.nonce
+        self.remote_auth_pub = hello.cert.pubkey.key
+        self.remote_version = hello.versionStr
+        self.remote_listening_port = hello.listeningPort
+        self._setup_session_keys()
+        self.state = PeerState.GOT_HELLO
+        if self.role == PeerRole.ACCEPTOR:
+            self._send_hello()
+        else:
+            self._send_auth()
+
+    def _recv_auth(self, auth) -> None:
+        if self.state != PeerState.GOT_HELLO:
+            self.close("AUTH out of order")
+            return
+        self.state = PeerState.GOT_AUTH
+        # initial flood credit both ways (ref FlowControl::start)
+        self.outbound_credit = PEER_FLOOD_READING_CAPACITY
+        if self.role == PeerRole.ACCEPTOR:
+            self._send_auth()
+        self.app.overlay_manager.peer_authenticated(self)
+
+    def send_error(self, code: int, msg: bytes) -> None:
+        try:
+            err = O.StellarMessage.make(
+                O.MessageType.ERROR_MSG,
+                O.Error.make(code=code, msg=msg))
+            if self.send_mac_key:
+                self._send_now(err)
+            else:
+                self._send_unauthenticated(err)
+        except Exception:
+            pass
+
+    def get_stats(self) -> dict:
+        return {
+            "id": self.peer_id.hex()[:8] if self.peer_id else "?",
+            "messages_read": self.messages_read,
+            "messages_written": self.messages_written,
+            "bytes_read": self.bytes_read,
+            "bytes_written": self.bytes_written,
+        }
+
+
+class LoopbackPeer(Peer):
+    """In-memory transport: writes enqueue into the partner's inbox,
+    drained via clock actions — deterministic in-process networks
+    (ref src/overlay/test/LoopbackPeer.h).  Damage/drop/duplicate knobs
+    support fault injection like the reference."""
+
+    def __init__(self, app, role: PeerRole):
+        super().__init__(app, role)
+        self.partner: Optional["LoopbackPeer"] = None
+        self.drop_probability = 0.0
+        self.damage_probability = 0.0
+        self.duplicate_probability = 0.0
+        self._rng = None
+
+    def set_damage(self, drop=0.0, damage=0.0, duplicate=0.0, seed=7):
+        import random
+
+        self.drop_probability = drop
+        self.damage_probability = damage
+        self.duplicate_probability = duplicate
+        self._rng = random.Random(seed)
+
+    def transport_write(self, data: bytes) -> None:
+        if self.partner is None or self.partner.state == PeerState.CLOSING:
+            return
+        deliveries = [data]
+        if self._rng is not None:
+            if self._rng.random() < self.drop_probability:
+                deliveries = []
+            elif self._rng.random() < self.duplicate_probability:
+                deliveries = [data, data]
+            if deliveries and self._rng.random() < self.damage_probability:
+                b = bytearray(deliveries[0])
+                b[self._rng.randrange(len(b))] ^= 0xFF
+                deliveries[0] = bytes(b)
+        partner = self.partner
+        for d in deliveries:
+            self.app.clock.post_action(
+                lambda d=d: partner.recv_bytes(d)
+                if partner.state != PeerState.CLOSING else None)
+
+
+def make_loopback_pair(app1, app2):
+    """Connect two apps with a loopback link; app1 is the initiator.
+    Handshake completes as the shared clock cranks."""
+    p1 = LoopbackPeer(app1, PeerRole.INITIATOR)
+    p2 = LoopbackPeer(app2, PeerRole.ACCEPTOR)
+    p1.partner = p2
+    p2.partner = p1
+    app1.overlay_manager.add_pending_peer(p1)
+    app2.overlay_manager.add_pending_peer(p2)
+    p1.start_handshake()
+    return p1, p2

@@ -1,0 +1,2 @@
+"""In-process multi-node simulation (ref src/simulation — SURVEY.md §4.2)."""
+from .simulation import Simulation, core, cycle, pair  # noqa: F401

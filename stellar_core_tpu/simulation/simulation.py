@@ -1,0 +1,146 @@
+"""Simulation: N full Applications in one process sharing a VirtualClock,
+wired over loopback links — whole consensus networks run deterministically
+at accelerated time (ref src/simulation/Simulation.h:29, Topologies.h;
+SURVEY.md §4.2: "how multi-node is tested without a cluster").
+
+This harness is also the TPU-mesh multi-validator driver: each node's
+admission batches dispatch to the shared device, validators map onto mesh
+slices (SURVEY.md §2.17 P4).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..crypto import SecretKey, sha256
+from ..main.application import Application
+from ..main.config import Config
+from ..overlay.manager import OverlayManager
+from ..overlay.peer import make_loopback_pair
+from ..utils.clock import ClockMode, VirtualClock
+
+
+class Simulation:
+    OVER_LOOPBACK = 0
+
+    def __init__(self, mode: int = OVER_LOOPBACK,
+                 network_passphrase: str = "test simulation network"):
+        self.clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        self.network_passphrase = network_passphrase
+        self.nodes: Dict[bytes, Application] = {}
+        self.node_seeds: Dict[bytes, bytes] = {}
+
+    # -- topology construction ---------------------------------------------
+
+    def add_node(self, seed: bytes, qset_spec: dict,
+                 **config_kw) -> Application:
+        """qset_spec: {"threshold": t, "validators": [node ids]}."""
+        cfg = Config(
+            NETWORK_PASSPHRASE=self.network_passphrase,
+            NODE_SEED=seed,
+            QUORUM_SET=qset_spec,
+            RUN_STANDALONE=False,
+            MANUAL_CLOSE=config_kw.pop("MANUAL_CLOSE", True),
+            ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING=True,
+            INVARIANT_CHECKS=[".*"],
+            **config_kw,
+        )
+        app = Application(self.clock, cfg)
+        app.overlay_manager = OverlayManager(app)
+        self.nodes[cfg.node_id()] = app
+        self.node_seeds[cfg.node_id()] = seed
+        return app
+
+    def add_connection(self, a: bytes, b: bytes) -> None:
+        make_loopback_pair(self.nodes[a], self.nodes[b])
+
+    def start_all_nodes(self) -> None:
+        for app in self.nodes.values():
+            app.start()
+
+    # -- driving ------------------------------------------------------------
+
+    def crank(self, block: bool = False) -> int:
+        return self.clock.crank(block)
+
+    def crank_until(self, pred: Callable[[], bool],
+                    timeout: float = 100.0) -> bool:
+        return self.clock.crank_until(pred, timeout)
+
+    def crank_for(self, seconds: float) -> None:
+        deadline = self.clock.now() + seconds
+        while self.clock.now() < deadline:
+            if self.clock.crank(block=True) == 0 and \
+                    self.clock.next_deadline() is None:
+                break
+
+    def have_all_externalized(self, seq: int) -> bool:
+        return all(
+            app.ledger_manager.last_closed_seq() >= seq
+            for app in self.nodes.values())
+
+    def trigger_all(self) -> None:
+        """Manual-close mode: every validator proposes for the next slot."""
+        for app in self.nodes.values():
+            app.herder.trigger_next_ledger()
+
+    def close_ledger(self, timeout: float = 60.0) -> bool:
+        """One consensus round across the whole network."""
+        target = max(app.ledger_manager.last_closed_seq()
+                     for app in self.nodes.values()) + 1
+        self.trigger_all()
+        return self.crank_until(
+            lambda: self.have_all_externalized(target), timeout)
+
+    # -- assertions ----------------------------------------------------------
+
+    def ledger_hashes(self, seq: Optional[int] = None) -> List[bytes]:
+        return [app.ledger_manager.last_closed_hash()
+                for app in self.nodes.values()]
+
+    def assert_in_sync(self) -> None:
+        hashes = self.ledger_hashes()
+        assert len(set(hashes)) == 1, [h.hex()[:8] for h in hashes]
+
+
+# -- canned topologies (ref src/simulation/Topologies.h:12-80) ---------------
+
+def _seeds(n: int) -> List[bytes]:
+    return [sha256(b"sim-node-%d" % i) for i in range(n)]
+
+
+def _ids(seeds: List[bytes]) -> List[bytes]:
+    return [SecretKey(s).public_key().raw for s in seeds]
+
+
+def core(n: int, threshold: Optional[int] = None,
+         passphrase: str = "test simulation network") -> Simulation:
+    """Fully-connected core-N: every validator trusts all N with the given
+    threshold (default 2f+1; ref Topologies::core)."""
+    sim = Simulation(network_passphrase=passphrase)
+    seeds = _seeds(n)
+    ids = _ids(seeds)
+    thr = threshold if threshold is not None else n - (n - 1) // 3
+    qset = {"threshold": thr, "validators": ids}
+    for s in seeds:
+        sim.add_node(s, qset)
+    for i in range(n):
+        for j in range(i + 1, n):
+            sim.add_connection(ids[i], ids[j])
+    return sim
+
+
+def pair(passphrase: str = "test simulation network") -> Simulation:
+    return core(2, threshold=2, passphrase=passphrase)
+
+
+def cycle(n: int, passphrase: str = "test simulation network") -> Simulation:
+    """Ring: each node trusts itself + both neighbors (2-of-3)."""
+    sim = Simulation(network_passphrase=passphrase)
+    seeds = _seeds(n)
+    ids = _ids(seeds)
+    for i, s in enumerate(seeds):
+        neighbors = [ids[i], ids[(i - 1) % n], ids[(i + 1) % n]]
+        sim.add_node(s, {"threshold": 2, "validators": neighbors})
+    for i in range(n):
+        sim.add_connection(ids[i], ids[(i + 1) % n])
+    return sim

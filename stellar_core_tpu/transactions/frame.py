@@ -314,11 +314,16 @@ class TransactionFrame:
 
     # -- apply (ledger close phase 2) --------------------------------------
 
-    def apply(self, ltx, verify: Optional[Callable] = None
+    def apply(self, ltx, verify: Optional[Callable] = None,
+              invariant_check: Optional[Callable] = None
               ) -> Tuple[bool, object, object]:
         """Apply operations all-or-nothing (ref apply :1752 /
         applyOperations :1388).  Returns (success, TransactionResult,
-        TransactionMeta-v2-value)."""
+        TransactionMeta-v2-value).  ``invariant_check(tx_ltx, frame, ok)``
+        runs against THIS tx's isolated delta before commit (ref
+        InvariantManager::checkOnOperationApply invoked from
+        TransactionFrame.cpp:1441) — scanning the whole close-level delta
+        per tx would be quadratic and misattribute violations."""
         checker = SignatureChecker(self.full_hash(), self.signatures, verify)
         with LedgerTxn(ltx) as tx_ltx:
             res = self.common_valid(tx_ltx, apply_seq=True, charge_fee=False)
@@ -354,6 +359,8 @@ class TransactionFrame:
                         self._make_result(TC.txBAD_AUTH_EXTRA, []),
                         _empty_meta())
             if success:
+                if invariant_check is not None:
+                    invariant_check(tx_ltx, self, True)
                 tx_ltx.commit()
                 self.result_code = TC.txSUCCESS
                 # pad remaining results (loop never breaks on success)

@@ -8,20 +8,13 @@ from __future__ import annotations
 from ...ledger.ledger_txn import entry_to_key
 from ...xdr import types as T
 from .. import utils as U
-from .base import OperationFrame, op_inner
+from .base import OperationFrame, op_inner, put_account, put_trustline
 
 OT = T.OperationType
 INT64_MAX = U.INT64_MAX
 
-
-def _put_account(ltx, entry, acc):
-    ltx.put(entry._replace(
-        data=T.LedgerEntryData.make(T.LedgerEntryType.ACCOUNT, acc)))
-
-
-def _put_trustline(ltx, entry, tl):
-    ltx.put(entry._replace(
-        data=T.LedgerEntryData.make(T.LedgerEntryType.TRUSTLINE, tl)))
+_put_account = put_account
+_put_trustline = put_trustline
 
 
 class BumpSequenceOpFrame(OperationFrame):

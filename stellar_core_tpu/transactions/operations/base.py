@@ -19,6 +19,16 @@ def op_inner(op_type: int, result_value) -> object:
         T.OperationResultTr.make(op_type, result_value))
 
 
+def put_account(ltx, entry, acc) -> None:
+    ltx.put(entry._replace(
+        data=T.LedgerEntryData.make(T.LedgerEntryType.ACCOUNT, acc)))
+
+
+def put_trustline(ltx, entry, tl) -> None:
+    ltx.put(entry._replace(
+        data=T.LedgerEntryData.make(T.LedgerEntryType.TRUSTLINE, tl)))
+
+
 def op_error(code: int) -> object:
     return T.OperationResult.make(code)
 

@@ -5,7 +5,9 @@ from __future__ import annotations
 
 from ...xdr import types as T
 from .. import utils as U
-from .base import OperationFrame, op_error, op_inner
+from .base import (
+    OperationFrame, op_error, op_inner, put_account, put_trustline,
+)
 
 OT = T.OperationType
 
@@ -39,8 +41,7 @@ class CreateAccountOpFrame(OperationFrame):
         if U.get_available_balance(header, src) < self.body.startingBalance:
             return self._res(C.CREATE_ACCOUNT_UNDERFUNDED)
         src = U.add_balance(src, -self.body.startingBalance)
-        ltx.put(src_entry._replace(
-            data=T.LedgerEntryData.make(T.LedgerEntryType.ACCOUNT, src)))
+        put_account(ltx, src_entry, src)
         ltx.put(U.make_account_entry(dest, self.body.startingBalance))
         return self._res(C.CREATE_ACCOUNT_SUCCESS)
 
@@ -83,16 +84,15 @@ class PaymentOpFrame(OperationFrame):
                 return self._res(C.PAYMENT_LINE_FULL)
             src = U.add_balance(src, -amount)
             dest = U.add_balance(dest, amount)
-            ltx.put(src_entry._replace(data=T.LedgerEntryData.make(
-                T.LedgerEntryType.ACCOUNT, src)))
-            ltx.put(dest_entry._replace(data=T.LedgerEntryData.make(
-                T.LedgerEntryType.ACCOUNT, dest)))
+            put_account(ltx, src_entry, src)
+            put_account(ltx, dest_entry, dest)
             return self._res(C.PAYMENT_SUCCESS)
 
         # credit asset
         issuer = U.asset_issuer(asset)
         src_is_issuer = src_id == issuer
         dest_is_issuer = dest_id == issuer
+        self_payment = src_id == dest_id
 
         if not src_is_issuer:
             tl_entry = ltx.load_trustline(src_id, asset)
@@ -115,16 +115,19 @@ class PaymentOpFrame(OperationFrame):
             if U.trustline_max_receive(dtl) < amount:
                 return self._res(C.PAYMENT_LINE_FULL)
 
+        if self_payment:
+            # src and dest share ONE trustline: writing both sides would
+            # overwrite the debit with the credit and mint money — all
+            # checks passed, net effect is zero
+            return self._res(C.PAYMENT_SUCCESS)
         if not src_is_issuer:
             tl = tl_entry.data.value._replace(
                 balance=tl_entry.data.value.balance - amount)
-            ltx.put(tl_entry._replace(data=T.LedgerEntryData.make(
-                T.LedgerEntryType.TRUSTLINE, tl)))
+            put_trustline(ltx, tl_entry, tl)
         if not dest_is_issuer:
             dtl = dtl_entry.data.value._replace(
                 balance=dtl_entry.data.value.balance + amount)
-            ltx.put(dtl_entry._replace(data=T.LedgerEntryData.make(
-                T.LedgerEntryType.TRUSTLINE, dtl)))
+            put_trustline(ltx, dtl_entry, dtl)
         return self._res(C.PAYMENT_SUCCESS)
 
 
@@ -159,9 +162,9 @@ class AccountMergeOpFrame(OperationFrame):
             return self._res_code(C.ACCOUNT_MERGE_HAS_SUB_ENTRIES)
         if U.num_sponsoring(src) != 0:
             return self._res_code(C.ACCOUNT_MERGE_IS_SPONSOR)
-        # seqnum must not be re-usable in this ledger (protocol >= 10)
-        max_seq = (header.ledgerSeq << 32) - 1
-        if src.seqNum >= max_seq:
+        # seqnum must not be re-usable in this ledger (protocol >= 10):
+        # reject only seqNum >= startingSequenceNumber(ledgerSeq)
+        if src.seqNum >= (header.ledgerSeq << 32):
             return self._res_code(C.ACCOUNT_MERGE_SEQNUM_TOO_FAR)
         dest = dest_entry.data.value
         if U.get_max_receive(header, dest) < src.balance:
@@ -169,8 +172,7 @@ class AccountMergeOpFrame(OperationFrame):
 
         balance = src.balance
         dest = U.add_balance(dest, balance)
-        ltx.put(dest_entry._replace(data=T.LedgerEntryData.make(
-            T.LedgerEntryType.ACCOUNT, dest)))
+        put_account(ltx, dest_entry, dest)
         from ...ledger.ledger_txn import entry_to_key
 
         ltx.erase(entry_to_key(src_entry))

@@ -1,0 +1,227 @@
+"""Overlay wire schema (ref src/protocol-curr/xdr/Stellar-overlay.x).
+
+Separate module from types.py: these are transport-layer messages, only the
+overlay imports them.
+"""
+from __future__ import annotations
+
+from .runtime import (
+    Enum, FixedArray, Hyper, Int, Opaque, Struct, Uhyper, Uint, Union,
+    VarArray, VarOpaque, XdrStr,
+)
+from .types import (
+    Curve25519Public, GeneralizedTransactionSet, Hash, HmacSha256Mac, NodeID,
+    SCPEnvelope, SCPQuorumSet, Signature, TransactionEnvelope,
+    TransactionSet, Uint256,
+)
+
+ErrorCode = Enum("ErrorCode", {
+    "ERR_MISC": 0,
+    "ERR_DATA": 1,
+    "ERR_CONF": 2,
+    "ERR_AUTH": 3,
+    "ERR_LOAD": 4,
+})
+
+Error = Struct("Error", [
+    ("code", ErrorCode),
+    ("msg", XdrStr(100)),
+])
+
+SendMore = Struct("SendMore", [("numMessages", Uint)])
+SendMoreExtended = Struct("SendMoreExtended", [
+    ("numMessages", Uint),
+    ("numBytes", Uint),
+])
+
+AuthCert = Struct("AuthCert", [
+    ("pubkey", Curve25519Public),
+    ("expiration", Uhyper),
+    ("sig", Signature),
+])
+
+Hello = Struct("Hello", [
+    ("ledgerVersion", Uint),
+    ("overlayVersion", Uint),
+    ("overlayMinVersion", Uint),
+    ("networkID", Hash),
+    ("versionStr", XdrStr(100)),
+    ("listeningPort", Int),
+    ("peerID", NodeID),
+    ("cert", AuthCert),
+    ("nonce", Uint256),
+])
+
+AUTH_MSG_FLAG_FLOW_CONTROL_BYTES_REQUESTED = 200
+
+Auth = Struct("Auth", [("flags", Int)])
+
+IPAddrType = Enum("IPAddrType", {"IPv4": 0, "IPv6": 1})
+
+_PeerAddressIp = Union("PeerAddressIp", IPAddrType, {
+    IPAddrType.IPv4: ("ipv4", Opaque(4)),
+    IPAddrType.IPv6: ("ipv6", Opaque(16)),
+})
+
+PeerAddress = Struct("PeerAddress", [
+    ("ip", _PeerAddressIp),
+    ("port", Uint),
+    ("numFailures", Uint),
+])
+
+MessageType = Enum("MessageType", {
+    "ERROR_MSG": 0,
+    "AUTH": 2,
+    "DONT_HAVE": 3,
+    "GET_PEERS": 4,
+    "PEERS": 5,
+    "GET_TX_SET": 6,
+    "TX_SET": 7,
+    "GENERALIZED_TX_SET": 17,
+    "TRANSACTION": 8,
+    "GET_SCP_QUORUMSET": 9,
+    "SCP_QUORUMSET": 10,
+    "SCP_MESSAGE": 11,
+    "GET_SCP_STATE": 12,
+    "HELLO": 13,
+    "SURVEY_REQUEST": 14,
+    "SURVEY_RESPONSE": 15,
+    "SEND_MORE": 16,
+    "SEND_MORE_EXTENDED": 20,
+    "FLOOD_ADVERT": 18,
+    "FLOOD_DEMAND": 19,
+})
+
+DontHave = Struct("DontHave", [
+    ("type", MessageType),
+    ("reqHash", Uint256),
+])
+
+SurveyMessageCommandType = Enum("SurveyMessageCommandType", {
+    "SURVEY_TOPOLOGY": 0,
+})
+
+SurveyMessageResponseType = Enum("SurveyMessageResponseType", {
+    "SURVEY_TOPOLOGY_RESPONSE_V0": 0,
+    "SURVEY_TOPOLOGY_RESPONSE_V1": 1,
+})
+
+SurveyRequestMessage = Struct("SurveyRequestMessage", [
+    ("surveyorPeerID", NodeID),
+    ("surveyedPeerID", NodeID),
+    ("ledgerNum", Uint),
+    ("encryptionKey", Curve25519Public),
+    ("commandType", SurveyMessageCommandType),
+])
+
+SignedSurveyRequestMessage = Struct("SignedSurveyRequestMessage", [
+    ("requestSignature", Signature),
+    ("request", SurveyRequestMessage),
+])
+
+EncryptedBody = VarOpaque(64000)
+
+SurveyResponseMessage = Struct("SurveyResponseMessage", [
+    ("surveyorPeerID", NodeID),
+    ("surveyedPeerID", NodeID),
+    ("ledgerNum", Uint),
+    ("commandType", SurveyMessageCommandType),
+    ("encryptedBody", EncryptedBody),
+])
+
+SignedSurveyResponseMessage = Struct("SignedSurveyResponseMessage", [
+    ("responseSignature", Signature),
+    ("response", SurveyResponseMessage),
+])
+
+PeerStats = Struct("PeerStats", [
+    ("id", NodeID),
+    ("versionStr", XdrStr(100)),
+    ("messagesRead", Uhyper),
+    ("messagesWritten", Uhyper),
+    ("bytesRead", Uhyper),
+    ("bytesWritten", Uhyper),
+    ("secondsConnected", Uhyper),
+    ("uniqueFloodBytesRecv", Uhyper),
+    ("duplicateFloodBytesRecv", Uhyper),
+    ("uniqueFetchBytesRecv", Uhyper),
+    ("duplicateFetchBytesRecv", Uhyper),
+    ("uniqueFloodMessageRecv", Uhyper),
+    ("duplicateFloodMessageRecv", Uhyper),
+    ("uniqueFetchMessageRecv", Uhyper),
+    ("duplicateFetchMessageRecv", Uhyper),
+])
+
+PeerStatList = VarArray(PeerStats, 25)
+
+TopologyResponseBodyV0 = Struct("TopologyResponseBodyV0", [
+    ("inboundPeers", PeerStatList),
+    ("outboundPeers", PeerStatList),
+    ("totalInboundPeerCount", Uint),
+    ("totalOutboundPeerCount", Uint),
+])
+
+TopologyResponseBodyV1 = Struct("TopologyResponseBodyV1", [
+    ("inboundPeers", PeerStatList),
+    ("outboundPeers", PeerStatList),
+    ("totalInboundPeerCount", Uint),
+    ("totalOutboundPeerCount", Uint),
+    ("maxInboundPeerCount", Uint),
+    ("maxOutboundPeerCount", Uint),
+])
+
+SurveyResponseBody = Union(
+    "SurveyResponseBody", SurveyMessageResponseType, {
+        SurveyMessageResponseType.SURVEY_TOPOLOGY_RESPONSE_V0:
+            ("topologyResponseBodyV0", TopologyResponseBodyV0),
+        SurveyMessageResponseType.SURVEY_TOPOLOGY_RESPONSE_V1:
+            ("topologyResponseBodyV1", TopologyResponseBodyV1),
+    })
+
+TX_ADVERT_VECTOR_MAX_SIZE = 1000
+TX_DEMAND_VECTOR_MAX_SIZE = 1000
+
+FloodAdvert = Struct("FloodAdvert", [
+    ("txHashes", VarArray(Hash, TX_ADVERT_VECTOR_MAX_SIZE)),
+])
+
+FloodDemand = Struct("FloodDemand", [
+    ("txHashes", VarArray(Hash, TX_DEMAND_VECTOR_MAX_SIZE)),
+])
+
+StellarMessage = Union("StellarMessage", MessageType, {
+    MessageType.ERROR_MSG: ("error", Error),
+    MessageType.HELLO: ("hello", Hello),
+    MessageType.AUTH: ("auth", Auth),
+    MessageType.DONT_HAVE: ("dontHave", DontHave),
+    MessageType.GET_PEERS: ("getPeers", None),
+    MessageType.PEERS: ("peers", VarArray(PeerAddress, 100)),
+    MessageType.GET_TX_SET: ("txSetHash", Uint256),
+    MessageType.TX_SET: ("txSet", TransactionSet),
+    MessageType.GENERALIZED_TX_SET:
+        ("generalizedTxSet", GeneralizedTransactionSet),
+    MessageType.TRANSACTION: ("transaction", TransactionEnvelope),
+    MessageType.SURVEY_REQUEST:
+        ("signedSurveyRequestMessage", SignedSurveyRequestMessage),
+    MessageType.SURVEY_RESPONSE:
+        ("signedSurveyResponseMessage", SignedSurveyResponseMessage),
+    MessageType.GET_SCP_QUORUMSET: ("qSetHash", Uint256),
+    MessageType.SCP_QUORUMSET: ("qSet", SCPQuorumSet),
+    MessageType.SCP_MESSAGE: ("envelope", SCPEnvelope),
+    MessageType.GET_SCP_STATE: ("getSCPLedgerSeq", Uint),
+    MessageType.SEND_MORE: ("sendMoreMessage", SendMore),
+    MessageType.SEND_MORE_EXTENDED:
+        ("sendMoreExtendedMessage", SendMoreExtended),
+    MessageType.FLOOD_ADVERT: ("floodAdvert", FloodAdvert),
+    MessageType.FLOOD_DEMAND: ("floodDemand", FloodDemand),
+})
+
+_AuthenticatedMessageV0 = Struct("AuthenticatedMessageV0", [
+    ("sequence", Uhyper),
+    ("message", StellarMessage),
+    ("mac", HmacSha256Mac),
+])
+
+AuthenticatedMessage = Union("AuthenticatedMessage", Uint, {
+    0: ("v0", _AuthenticatedMessageV0),
+})

@@ -105,3 +105,26 @@ def test_all_live_entries_flatten():
     for e in entries:
         assert live[kb_of(e)].data.value.balance == \
             e.data.value.balance
+
+
+def test_delete_recreate_delete_stays_dead():
+    """Regression (review finding): INIT over DEAD must become LIVE so the
+    second deletion keeps a tombstone instead of annihilating."""
+    bl = BucketList()
+    e = acct(3, balance=1)
+    kb = kb_of(e)
+    bl.add_batch(2, [(kb, e, False)])     # create
+    # spill deep
+    for seq in range(3, 20):
+        f = acct(50 + seq)
+        bl.add_batch(seq, [(kb_of(f), f, False)])
+    bl.add_batch(20, [(kb, None, True)])  # delete
+    e2 = acct(3, balance=2)
+    bl.add_batch(21, [(kb, e2, False)])   # recreate
+    assert bl.get_entry(kb).data.value.balance == 2
+    bl.add_batch(22, [(kb, None, True)])  # delete again
+    for seq in range(23, 60):
+        f = acct(90 + seq)
+        bl.add_batch(seq, [(kb_of(f), f, False)])
+        assert bl.get_entry(kb) is None, seq
+    assert kb not in bl.all_live_entries()

@@ -240,3 +240,21 @@ def test_all_or_nothing_apply(root):
     assert b.balance() == bal_b  # rolled back
     # fee still charged, seq still bumped
     assert a.loaded_seq() == 1
+
+
+def test_credit_self_payment_is_noop(root):
+    """Regression (review finding): a credit-asset self-payment must not
+    mint — src and dest share one trustline."""
+    issuer = root.create("issuer9", 100 * BASE_RESERVE)
+    alice = root.create("alice9", 100 * BASE_RESERVE)
+    usd = U.make_asset(b"USD", issuer.account_id)
+    alice.apply(alice.tx([alice.op_change_trust(usd)]))
+    issuer.apply(issuer.tx([issuer.op_payment(
+        alice.account_id, 500, asset=usd)]))
+    # self-payment: balance must stay exactly 500
+    alice.apply(alice.tx([alice.op_payment(
+        alice.account_id, 300, asset=usd)]))
+    with LedgerTxn(root.ledger.root_txn) as ltx:
+        tl = ltx.load_trustline(alice.account_id, usd)
+        ltx.rollback()
+    assert tl.data.value.balance == 500

@@ -78,6 +78,10 @@ class TestAccount:
     def from_name(cls, ledger: TestLedger, name: str) -> "TestAccount":
         return cls(ledger, SecretKey(sha256(name.encode())))
 
+    def network_id(self) -> bytes:
+        """Override when the account signs for a non-default network."""
+        return NETWORK_ID
+
     def loaded_seq(self) -> int:
         with LedgerTxn(self.ledger.root_txn) as ltx:
             e = ltx.load_account(self.account_id)
@@ -152,7 +156,7 @@ class TestAccount:
             ext=T.Transaction.fields[6][1].make(0),
         )
         payload = T.TransactionSignaturePayload.make(
-            networkId=NETWORK_ID,
+            networkId=self.network_id(),
             taggedTransaction=T.TransactionSignaturePayload.fields[1][1]
             .make(T.EnvelopeType.ENVELOPE_TYPE_TX, tx))
         h = sha256(T.TransactionSignaturePayload.encode(payload))
