@@ -1,0 +1,378 @@
+"""OfferExchange: the DEX engine — order-book crossing with the protocol's
+rounding-fairness rules (ref src/transactions/OfferExchange.{h,cpp}; design
+essay at OfferExchange.h:87-163).
+
+All the reference's uint128 intermediate math is exact Python int here —
+the bit-identical-results requirement (SURVEY.md §7 "hard parts") keeps
+this on host CPU, never on device.
+
+Terminology follows the reference: the book offer sells WHEAT and buys
+SHEEP at ``price`` = sheep-per-wheat (price.n/price.d); the taker sends
+sheep and receives wheat.
+"""
+from __future__ import annotations
+
+from enum import Enum
+from typing import Callable, List, Optional, Tuple
+
+from ..xdr import types as T
+from . import utils as U
+
+INT64_MAX = U.INT64_MAX
+
+
+class RoundingType(Enum):
+    NORMAL = 0
+    PATH_PAYMENT_STRICT_RECEIVE = 1
+    PATH_PAYMENT_STRICT_SEND = 2
+
+
+class ExchangeError(Exception):
+    pass
+
+
+def big_divide(a: int, b: int, c: int, round_up: bool) -> int:
+    """floor/ceil of a*b/c with int64 overflow check
+    (ref bigDivideOrThrow)."""
+    x = a * b
+    res = -((-x) // c) if round_up else x // c
+    if res > INT64_MAX or res < 0:
+        raise ExchangeError("int64 overflow in division")
+    return res
+
+
+def _div128(x: int, c: int, round_up: bool) -> int:
+    res = -((-x) // c) if round_up else x // c
+    if res > INT64_MAX or res < 0:
+        raise ExchangeError("int64 overflow in division")
+    return res
+
+
+def calculate_offer_value(price_n: int, price_d: int, max_send: int,
+                          max_receive: int) -> int:
+    """min(maxSend*priceN, maxReceive*priceD)
+    (ref calculateOfferValue :219)."""
+    return min(max_send * price_n, max_receive * price_d)
+
+
+class ExchangeResultV10:
+    __slots__ = ("num_wheat_received", "num_sheep_send", "wheat_stays")
+
+    def __init__(self, wheat_receive: int, sheep_send: int,
+                 wheat_stays: bool):
+        self.num_wheat_received = wheat_receive
+        self.num_sheep_send = sheep_send
+        self.wheat_stays = wheat_stays
+
+
+def _exchange_v10_without_thresholds(
+        price, max_wheat_send: int, max_wheat_receive: int,
+        max_sheep_send: int, max_sheep_receive: int,
+        round_: RoundingType) -> ExchangeResultV10:
+    """ref exchangeV10WithoutPriceErrorThresholds :631."""
+    wheat_value = calculate_offer_value(
+        price.n, price.d, max_wheat_send, max_sheep_receive)
+    sheep_value = calculate_offer_value(
+        price.d, price.n, max_sheep_send, max_wheat_receive)
+    wheat_stays = wheat_value > sheep_value
+
+    if wheat_stays:
+        if round_ == RoundingType.PATH_PAYMENT_STRICT_SEND:
+            wheat_receive = _div128(sheep_value, price.n, False)
+            sheep_send = min(max_sheep_send, max_sheep_receive)
+        elif price.n > price.d or \
+                round_ == RoundingType.PATH_PAYMENT_STRICT_RECEIVE:
+            wheat_receive = _div128(sheep_value, price.n, False)
+            sheep_send = big_divide(wheat_receive, price.n, price.d, True)
+        else:  # sheep is more valuable
+            sheep_send = _div128(sheep_value, price.d, False)
+            wheat_receive = big_divide(sheep_send, price.d, price.n, False)
+    else:
+        if price.n > price.d:  # wheat is more valuable
+            wheat_receive = _div128(wheat_value, price.n, False)
+            sheep_send = big_divide(wheat_receive, price.n, price.d, False)
+        else:
+            sheep_send = _div128(wheat_value, price.d, False)
+            wheat_receive = big_divide(sheep_send, price.d, price.n, True)
+
+    if wheat_receive < 0 or \
+            wheat_receive > min(max_wheat_receive, max_wheat_send):
+        raise ExchangeError("wheatReceive out of bounds")
+    if sheep_send < 0 or sheep_send > min(max_sheep_receive, max_sheep_send):
+        raise ExchangeError("sheepSend out of bounds")
+    return ExchangeResultV10(wheat_receive, sheep_send, wheat_stays)
+
+
+def check_price_error_bound(price, wheat_receive: int, sheep_send: int,
+                            can_favor_wheat: bool) -> bool:
+    """Relative price error <= 1% (ref checkPriceErrorBound :187)."""
+    lhs = 100 * price.n * wheat_receive
+    rhs = 100 * price.d * sheep_send
+    if can_favor_wheat and rhs > lhs:
+        return True
+    abs_diff = abs(lhs - rhs)
+    cap = price.n * wheat_receive
+    return abs_diff <= cap
+
+
+def _apply_price_error_thresholds(price, wheat_receive: int,
+                                  sheep_send: int, wheat_stays: bool,
+                                  round_: RoundingType) -> ExchangeResultV10:
+    """ref applyPriceErrorThresholds :702."""
+    if wheat_receive > 0 and sheep_send > 0:
+        wheat_receive_value = wheat_receive * price.n
+        sheep_send_value = sheep_send * price.d
+        if wheat_stays and sheep_send_value < wheat_receive_value:
+            raise ExchangeError("favored sheep when wheat stays")
+        if not wheat_stays and sheep_send_value > wheat_receive_value:
+            raise ExchangeError("favored wheat when sheep stays")
+        if round_ == RoundingType.NORMAL:
+            if not check_price_error_bound(
+                    price, wheat_receive, sheep_send, False):
+                sheep_send = 0
+                wheat_receive = 0
+        else:
+            if not check_price_error_bound(
+                    price, wheat_receive, sheep_send, True):
+                raise ExchangeError("exceeded price error bound")
+    else:
+        if round_ == RoundingType.PATH_PAYMENT_STRICT_SEND:
+            if sheep_send == 0:
+                raise ExchangeError("invalid amount of sheep sent")
+        else:
+            wheat_receive = 0
+            sheep_send = 0
+    return ExchangeResultV10(wheat_receive, sheep_send, wheat_stays)
+
+
+def exchange_v10(price, max_wheat_send: int, max_wheat_receive: int,
+                 max_sheep_send: int, max_sheep_receive: int,
+                 round_: RoundingType = RoundingType.NORMAL
+                 ) -> ExchangeResultV10:
+    """ref exchangeV10 :551."""
+    before = _exchange_v10_without_thresholds(
+        price, max_wheat_send, max_wheat_receive, max_sheep_send,
+        max_sheep_receive, round_)
+    return _apply_price_error_thresholds(
+        price, before.num_wheat_received, before.num_sheep_send,
+        before.wheat_stays, round_)
+
+
+def adjust_offer_amount(price, max_wheat_send: int,
+                        max_sheep_receive: int) -> int:
+    """Largest effectively-executable offer amount given seller capacity
+    (ref adjustOffer :784): run exchangeV10 against an unbounded taker and
+    keep what would actually trade."""
+    res = exchange_v10(price, max_wheat_send, INT64_MAX, INT64_MAX,
+                       max_sheep_receive, RoundingType.NORMAL)
+    return res.num_wheat_received
+
+
+# -- offer liabilities (ref getOfferBuyingLiabilities / Selling) -------------
+
+def offer_selling_liabilities(price, amount: int) -> int:
+    res = _exchange_v10_without_thresholds(
+        price, amount, INT64_MAX, INT64_MAX, INT64_MAX,
+        RoundingType.NORMAL)
+    return res.num_wheat_received
+
+
+def offer_buying_liabilities(price, amount: int) -> int:
+    res = _exchange_v10_without_thresholds(
+        price, amount, INT64_MAX, INT64_MAX, INT64_MAX,
+        RoundingType.NORMAL)
+    return res.num_sheep_send
+
+
+# -- seller capacity (ref canSellAtMost / canBuyAtMost :55-107) ---------------
+
+def can_sell_at_most(header, ltx, account_id: bytes, asset) -> int:
+    if U.is_native(asset):
+        entry = ltx.load_account(account_id)
+        if entry is None:
+            return 0
+        return U.get_available_balance(header, entry.data.value)
+    if U.asset_issuer(asset) == account_id:
+        return INT64_MAX
+    tl_entry = ltx.load_trustline(account_id, asset)
+    if tl_entry is None:
+        return 0
+    tl = tl_entry.data.value
+    if not U.is_authorized(tl):
+        return 0
+    return U.trustline_available_balance(tl)
+
+
+def can_buy_at_most(header, ltx, account_id: bytes, asset) -> int:
+    if U.is_native(asset):
+        entry = ltx.load_account(account_id)
+        if entry is None:
+            return 0
+        return max(0, U.get_max_receive(header, entry.data.value))
+    if U.asset_issuer(asset) == account_id:
+        return INT64_MAX
+    tl_entry = ltx.load_trustline(account_id, asset)
+    if tl_entry is None:
+        return 0
+    tl = tl_entry.data.value
+    if not U.is_authorized(tl):
+        return 0
+    return max(0, U.trustline_max_receive(tl))
+
+
+# -- balance transfer helpers ------------------------------------------------
+
+def _credit(ltx, header, account_id: bytes, asset, delta: int) -> bool:
+    """Add ``delta`` (may be negative) of asset to the account; False on
+    capacity violation."""
+    from .operations.base import put_account, put_trustline
+
+    if U.is_native(asset):
+        entry = ltx.load_account(account_id)
+        if entry is None:
+            return False
+        acc = U.add_balance(entry.data.value, delta)
+        if acc is None:
+            return False
+        put_account(ltx, entry, acc)
+        return True
+    if U.asset_issuer(asset) == account_id:
+        return True  # issuers mint/burn freely
+    tl_entry = ltx.load_trustline(account_id, asset)
+    if tl_entry is None:
+        return False
+    tl = tl_entry.data.value
+    nb = tl.balance + delta
+    if nb < 0 or nb > tl.limit:
+        return False
+    put_trustline(ltx, tl_entry, tl._replace(balance=nb))
+    return True
+
+
+# -- the crossing loop --------------------------------------------------------
+
+class ConvertResult(Enum):
+    OK = 0
+    PARTIAL = 1           # stopped (no more offers / limit) before filled
+    FILTER_STOP = 2       # price filter stopped crossing
+    CROSSED_SELF = 3
+    TOO_MANY_OFFERS = 4
+
+
+def convert_with_offers(
+    ltx, header, source_id: bytes,
+    sheep, max_sheep_send: int,
+    wheat, max_wheat_receive: int,
+    round_: RoundingType,
+    price_filter: Optional[Callable] = None,
+) -> Tuple[ConvertResult, int, int, List[object]]:
+    """Cross book offers selling ``wheat`` for ``sheep`` until limits are
+    exhausted (ref convertWithOffersAndPools :316 / crossOfferV10).
+
+    price_filter(offer_entry) -> False stops crossing (the manage-offer
+    own-price bound).  Returns (result, sheep_sent, wheat_received,
+    claim_atoms).  Balance effects for the SOURCE side are left to the
+    caller; book sellers are debited/credited here.
+    """
+    from ..ledger.ledger_txn import entry_to_key
+
+    sheep_b = T.Asset.encode(sheep)
+    wheat_b = T.Asset.encode(wheat)
+    sheep_sent = 0
+    wheat_received = 0
+    atoms: List[object] = []
+    crossed = 0
+
+    while max_sheep_send - sheep_sent > 0 and \
+            max_wheat_receive - wheat_received > 0:
+        entry = ltx.best_offer(wheat_b, sheep_b)
+        if entry is None:
+            break
+        if crossed >= U.MAX_OFFERS_TO_CROSS:
+            return (ConvertResult.TOO_MANY_OFFERS, sheep_sent,
+                    wheat_received, atoms)
+        oe = entry.data.value
+        if price_filter is not None and not price_filter(oe):
+            return (ConvertResult.FILTER_STOP, sheep_sent,
+                    wheat_received, atoms)
+        seller_id = oe.sellerID.value
+        if seller_id == source_id:
+            return (ConvertResult.CROSSED_SELF, sheep_sent,
+                    wheat_received, atoms)
+
+        # seller capacity (ref crossOfferV10 :791-792)
+        max_wheat_send_offer = min(
+            oe.amount, can_sell_at_most(header, ltx, seller_id, wheat))
+        max_sheep_receive_offer = can_buy_at_most(
+            header, ltx, seller_id, sheep)
+        adjusted = adjust_offer_amount(
+            oe.price, max_wheat_send_offer, max_sheep_receive_offer)
+        if adjusted == 0:
+            _delete_offer(ltx, entry)
+            crossed += 1
+            continue
+
+        res = exchange_v10(
+            oe.price, adjusted, max_wheat_receive - wheat_received,
+            max_sheep_send - sheep_sent, INT64_MAX, round_)
+        crossed += 1
+
+        if res.num_wheat_received > 0:
+            # move assets on the seller side
+            ok1 = _credit(ltx, header, seller_id, wheat,
+                          -res.num_wheat_received)
+            ok2 = _credit(ltx, header, seller_id, sheep,
+                          res.num_sheep_send)
+            if not (ok1 and ok2):
+                raise ExchangeError("seller balance transfer failed")
+            atoms.append(T.ClaimAtom.make(
+                T.ClaimAtomType.CLAIM_ATOM_TYPE_ORDER_BOOK,
+                T.ClaimOfferAtom.make(
+                    sellerID=oe.sellerID,
+                    offerID=oe.offerID,
+                    assetSold=wheat,
+                    amountSold=res.num_wheat_received,
+                    assetBought=sheep,
+                    amountBought=res.num_sheep_send)))
+            sheep_sent += res.num_sheep_send
+            wheat_received += res.num_wheat_received
+
+        if res.wheat_stays:
+            # offer remains: shrink + re-adjust
+            new_amount = adjust_offer_amount(
+                oe.price,
+                min(oe.amount - res.num_wheat_received,
+                    can_sell_at_most(header, ltx, seller_id, wheat)),
+                can_buy_at_most(header, ltx, seller_id, sheep))
+            if new_amount == 0:
+                _delete_offer(ltx, entry)
+            else:
+                from .operations.base import put_account  # noqa: F401
+
+                oe2 = oe._replace(amount=new_amount)
+                ltx.put(entry._replace(data=T.LedgerEntryData.make(
+                    T.LedgerEntryType.OFFER, oe2)))
+            break  # taker exhausted
+        else:
+            _delete_offer(ltx, entry)
+
+    if max_wheat_receive - wheat_received > 0 and \
+            max_sheep_send - sheep_sent > 0:
+        return (ConvertResult.PARTIAL, sheep_sent, wheat_received, atoms)
+    return (ConvertResult.OK, sheep_sent, wheat_received, atoms)
+
+
+def _delete_offer(ltx, entry) -> None:
+    """Remove an offer + its subentry count on the owner
+    (liabilities on resting offers are not tracked separately here; the
+    capacity recomputation above bounds execution)."""
+    from ..ledger.ledger_txn import entry_to_key
+    from .operations.base import put_account
+
+    oe = entry.data.value
+    owner = ltx.load_account(oe.sellerID.value)
+    ltx.erase(entry_to_key(entry))
+    if owner is not None:
+        acc = owner.data.value
+        put_account(ltx, owner, acc._replace(
+            numSubEntries=max(0, acc.numSubEntries - 1)))

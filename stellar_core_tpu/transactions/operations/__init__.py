@@ -9,6 +9,11 @@ from .account_ops import (  # noqa: F401
     SetTrustLineFlagsOpFrame,
 )
 from .base import OperationFrame, op_error, op_inner  # noqa: F401
+from .offers import (  # noqa: F401
+    CreatePassiveSellOfferOpFrame, ManageBuyOfferOpFrame,
+    ManageSellOfferOpFrame, PathPaymentStrictReceiveOpFrame,
+    PathPaymentStrictSendOpFrame,
+)
 from .payments import (  # noqa: F401
     AccountMergeOpFrame, CreateAccountOpFrame, PaymentOpFrame,
 )
@@ -27,6 +32,11 @@ _REGISTRY = {
     OT.SET_TRUST_LINE_FLAGS: SetTrustLineFlagsOpFrame,
     OT.CLAWBACK: ClawbackOpFrame,
     OT.INFLATION: InflationOpFrame,
+    OT.MANAGE_SELL_OFFER: ManageSellOfferOpFrame,
+    OT.MANAGE_BUY_OFFER: ManageBuyOfferOpFrame,
+    OT.CREATE_PASSIVE_SELL_OFFER: CreatePassiveSellOfferOpFrame,
+    OT.PATH_PAYMENT_STRICT_RECEIVE: PathPaymentStrictReceiveOpFrame,
+    OT.PATH_PAYMENT_STRICT_SEND: PathPaymentStrictSendOpFrame,
 }
 
 

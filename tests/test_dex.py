@@ -1,0 +1,282 @@
+"""DEX tests: exchangeV10 math + order-book crossing + path payments
+(ref models: src/transactions/test/{ExchangeTests,OfferTests,
+PathPaymentTests}.cpp)."""
+import pytest
+
+from stellar_core_tpu.ledger import LedgerTxn
+from stellar_core_tpu.transactions import utils as U
+from stellar_core_tpu.transactions.offer_exchange import (
+    RoundingType, exchange_v10, adjust_offer_amount,
+)
+from stellar_core_tpu.xdr import types as T
+
+from tests.txtest import BASE_FEE, BASE_RESERVE, TestLedger
+
+INT64_MAX = U.INT64_MAX
+
+
+@pytest.fixture()
+def ledger():
+    return TestLedger()
+
+
+@pytest.fixture()
+def root(ledger):
+    return ledger.root()
+
+
+def price(n, d):
+    return T.Price.make(n=n, d=d)
+
+
+# -- exchangeV10 math --------------------------------------------------------
+
+
+def test_exchange_v10_exact_price():
+    # book sells 100 wheat at 1 sheep/wheat; taker sends 30 sheep
+    res = exchange_v10(price(1, 1), 100, INT64_MAX, 30, INT64_MAX)
+    assert res.num_wheat_received == 30
+    assert res.num_sheep_send == 30
+    assert res.wheat_stays
+
+
+def test_exchange_v10_full_take():
+    res = exchange_v10(price(1, 1), 100, INT64_MAX, 500, INT64_MAX)
+    assert res.num_wheat_received == 100
+    assert res.num_sheep_send == 100
+    assert not res.wheat_stays
+
+
+def test_exchange_v10_rounding_favors_stayer():
+    # price 3/2 sheep per wheat, taker sends 5 sheep for at most
+    # floor(5*2/3)=3 wheat; wheat stays -> wheat seller favored
+    res = exchange_v10(price(3, 2), 1000, INT64_MAX, 5, INT64_MAX)
+    assert res.wheat_stays
+    # wheat seller gets at least the fair price
+    assert res.num_sheep_send * 2 >= res.num_wheat_received * 3
+
+
+def test_exchange_v10_price_error_bound():
+    # tiny exchange at an extreme price: >1% error must cancel the trade
+    res = exchange_v10(price(1000001, 1000000), 1, INT64_MAX, 1, INT64_MAX,
+                       RoundingType.NORMAL)
+    # 1-for-1 at ~1.000001 has relative error ~1e-6: fine
+    assert res.num_wheat_received in (0, 1)
+    res2 = exchange_v10(price(3, 1), 1, INT64_MAX, 1, INT64_MAX)
+    # taker would need to send 3 sheep for 1 wheat but only has 1:
+    # 0-or-cancelled
+    assert res2.num_wheat_received == 0
+
+
+def test_adjust_offer_caps_to_capacity():
+    assert adjust_offer_amount(price(1, 1), 100, 40) == 40
+    assert adjust_offer_amount(price(2, 1), 100, 100) == 50
+    assert adjust_offer_amount(price(1, 1), 0, 100) == 0
+
+
+# -- order-book crossing through ops -----------------------------------------
+
+
+def op_sell(acct, selling, buying, amount, p, offer_id=0):
+    return acct.op(T.OperationType.MANAGE_SELL_OFFER,
+                   T.ManageSellOfferOp.make(
+                       selling=selling, buying=buying, amount=amount,
+                       price=p, offerID=offer_id))
+
+
+def op_buy(acct, selling, buying, buy_amount, p, offer_id=0):
+    return acct.op(T.OperationType.MANAGE_BUY_OFFER,
+                   T.ManageBuyOfferOp.make(
+                       selling=selling, buying=buying,
+                       buyAmount=buy_amount, price=p, offerID=offer_id))
+
+
+def _mk_market(root):
+    issuer = root.create("dex-issuer", 1000 * BASE_RESERVE)
+    alice = root.create("dex-alice", 1000 * BASE_RESERVE)
+    bob = root.create("dex-bob", 1000 * BASE_RESERVE)
+    usd = U.make_asset(b"USD", issuer.account_id)
+    for who in (alice, bob):
+        who.apply(who.tx([who.op_change_trust(usd)]))
+    issuer.apply(issuer.tx([issuer.op_payment(
+        alice.account_id, 10_000, asset=usd)]))
+    issuer.apply(issuer.tx([issuer.op_payment(
+        bob.account_id, 10_000, asset=usd)]))
+    return issuer, alice, bob, usd
+
+
+def _usd_balance(root, who, usd):
+    with LedgerTxn(root.ledger.root_txn) as ltx:
+        tl = ltx.load_trustline(who.account_id, usd)
+        ltx.rollback()
+    return tl.data.value.balance
+
+
+def test_offer_create_and_rest(root):
+    issuer, alice, bob, usd = _mk_market(root)
+    xlm = U.asset_native()
+    # alice sells 1000 USD for XLM at 2 XLM/USD
+    ok, result = alice.apply(alice.tx([op_sell(
+        alice, usd, xlm, 1000, price(2, 1))]))
+    success = result.result.value[0].value.value.value
+    assert success.offer.type == T.ManageOfferEffect.MANAGE_OFFER_CREATED
+    offer = success.offer.value
+    assert offer.amount == 1000
+    # resting offer is in the book
+    with LedgerTxn(root.ledger.root_txn) as ltx:
+        best = ltx.best_offer(T.Asset.encode(usd), T.Asset.encode(xlm))
+        ltx.rollback()
+    assert best is not None and best.data.value.offerID == offer.offerID
+
+
+def test_offer_crossing_full_fill(root):
+    issuer, alice, bob, usd = _mk_market(root)
+    xlm = U.asset_native()
+    alice_usd0 = _usd_balance(root, alice, usd)
+    bob_usd0 = _usd_balance(root, bob, usd)
+    alice_xlm0 = alice.balance()
+
+    # alice sells 1000 USD at 2 XLM per USD
+    alice.apply(alice.tx([op_sell(alice, usd, xlm, 1000, price(2, 1))]))
+    # bob sells 2000 XLM for USD at 0.5 USD/XLM (the exact reciprocal)
+    ok, result = bob.apply(bob.tx([op_sell(
+        bob, xlm, usd, 2000, price(1, 2))]))
+    success = result.result.value[0].value.value.value
+    assert len(success.offersClaimed) == 1
+    atom = success.offersClaimed[0].value
+    assert atom.amountSold == 1000      # USD sold by alice's offer
+    assert atom.amountBought == 2000    # XLM paid by bob
+    assert success.offer.type == T.ManageOfferEffect.MANAGE_OFFER_DELETED
+    # balances moved both ways
+    assert _usd_balance(root, alice, usd) == alice_usd0 - 1000
+    assert _usd_balance(root, bob, usd) == bob_usd0 + 1000
+    assert alice.balance() == alice_xlm0 + 2000 - BASE_FEE
+
+
+def test_offer_partial_fill_rests(root):
+    issuer, alice, bob, usd = _mk_market(root)
+    xlm = U.asset_native()
+    alice.apply(alice.tx([op_sell(alice, usd, xlm, 100, price(1, 1))]))
+    # bob wants much more USD than alice offers
+    ok, result = bob.apply(bob.tx([op_sell(
+        bob, xlm, usd, 500, price(1, 1))]))
+    success = result.result.value[0].value.value.value
+    assert success.offer.type == T.ManageOfferEffect.MANAGE_OFFER_CREATED
+    assert success.offer.value.amount == 400  # 500 - 100 crossed
+    assert _usd_balance(root, bob, usd) == 10_000 + 100
+
+
+def test_no_cross_when_prices_dont_meet(root):
+    issuer, alice, bob, usd = _mk_market(root)
+    xlm = U.asset_native()
+    # alice asks 2 XLM per USD; bob bids only 1 XLM per USD
+    alice.apply(alice.tx([op_sell(alice, usd, xlm, 100, price(2, 1))]))
+    ok, result = bob.apply(bob.tx([op_sell(
+        bob, xlm, usd, 100, price(1, 1))]))
+    success = result.result.value[0].value.value.value
+    assert len(success.offersClaimed) == 0
+    assert success.offer.type == T.ManageOfferEffect.MANAGE_OFFER_CREATED
+
+
+def test_cannot_cross_own_offer(root):
+    issuer, alice, bob, usd = _mk_market(root)
+    xlm = U.asset_native()
+    alice.apply(alice.tx([op_sell(alice, usd, xlm, 100, price(1, 1))]))
+    ok, result = alice.apply(alice.tx([op_sell(
+        alice, xlm, usd, 100, price(1, 1))]), expect_success=False)
+    code = result.result.value[0].value.value.type
+    assert code == T.ManageSellOfferResultCode.MANAGE_SELL_OFFER_CROSS_SELF
+
+
+def test_delete_offer(root):
+    issuer, alice, bob, usd = _mk_market(root)
+    xlm = U.asset_native()
+    ok, result = alice.apply(alice.tx([op_sell(
+        alice, usd, xlm, 100, price(1, 1))]))
+    oid = result.result.value[0].value.value.value.offer.value.offerID
+    ok, result = alice.apply(alice.tx([op_sell(
+        alice, usd, xlm, 0, price(1, 1), offer_id=oid)]))
+    success = result.result.value[0].value.value.value
+    assert success.offer.type == T.ManageOfferEffect.MANAGE_OFFER_DELETED
+    with LedgerTxn(root.ledger.root_txn) as ltx:
+        assert ltx.best_offer(T.Asset.encode(usd),
+                              T.Asset.encode(xlm)) is None
+        ltx.rollback()
+
+
+def test_manage_buy_offer_crosses(root):
+    issuer, alice, bob, usd = _mk_market(root)
+    xlm = U.asset_native()
+    alice.apply(alice.tx([op_sell(alice, usd, xlm, 1000, price(2, 1))]))
+    # bob buys exactly 300 USD paying XLM at up to 2 XLM/USD
+    ok, result = bob.apply(bob.tx([op_buy(
+        bob, xlm, usd, 300, price(2, 1))]))
+    success = result.result.value[0].value.value.value
+    assert len(success.offersClaimed) == 1
+    assert success.offersClaimed[0].value.amountSold == 300
+    assert _usd_balance(root, bob, usd) == 10_000 + 300
+    # CAP-0006: nothing rests after the buy amount is filled
+    assert success.offer.type == T.ManageOfferEffect.MANAGE_OFFER_DELETED
+
+
+def test_passive_offer_no_cross_at_equal_price(root):
+    issuer, alice, bob, usd = _mk_market(root)
+    xlm = U.asset_native()
+    alice.apply(alice.tx([op_sell(alice, usd, xlm, 100, price(1, 1))]))
+    env = bob.tx([bob.op(T.OperationType.CREATE_PASSIVE_SELL_OFFER,
+                         T.CreatePassiveSellOfferOp.make(
+                             selling=xlm, buying=usd, amount=100,
+                             price=price(1, 1)))])
+    ok, result = bob.apply(env)
+    success = result.result.value[0].value.value.value
+    assert len(success.offersClaimed) == 0  # equal price + passive: no cross
+    assert success.offer.type == T.ManageOfferEffect.MANAGE_OFFER_CREATED
+
+
+def test_path_payment_strict_receive(root):
+    issuer, alice, bob, usd = _mk_market(root)
+    xlm = U.asset_native()
+    # book: alice sells USD for XLM at 1:1
+    alice.apply(alice.tx([op_sell(alice, usd, xlm, 5000, price(1, 1))]))
+    # bob sends XLM, carol receives exactly 700 USD
+    carol = root.create("dex-carol", 100 * BASE_RESERVE)
+    carol.apply(carol.tx([carol.op_change_trust(usd)]))
+    env = bob.tx([bob.op(T.OperationType.PATH_PAYMENT_STRICT_RECEIVE,
+                         T.PathPaymentStrictReceiveOp.make(
+                             sendAsset=xlm, sendMax=1000,
+                             destination=T.muxed_account(carol.account_id),
+                             destAsset=usd, destAmount=700, path=[]))])
+    ok, result = bob.apply(env)
+    assert _usd_balance(root, carol, usd) == 700
+
+
+def test_path_payment_strict_send(root):
+    issuer, alice, bob, usd = _mk_market(root)
+    xlm = U.asset_native()
+    alice.apply(alice.tx([op_sell(alice, usd, xlm, 5000, price(1, 1))]))
+    carol = root.create("dex-carol2", 100 * BASE_RESERVE)
+    carol.apply(carol.tx([carol.op_change_trust(usd)]))
+    env = bob.tx([bob.op(T.OperationType.PATH_PAYMENT_STRICT_SEND,
+                         T.PathPaymentStrictSendOp.make(
+                             sendAsset=xlm, sendAmount=800,
+                             destination=T.muxed_account(carol.account_id),
+                             destAsset=usd, destMin=700, path=[]))])
+    ok, result = bob.apply(env)
+    got = _usd_balance(root, carol, usd)
+    assert got >= 700  # at 1:1 bob's 800 XLM buys ~800 USD
+
+
+def test_path_payment_too_few_offers(root):
+    issuer, alice, bob, usd = _mk_market(root)
+    xlm = U.asset_native()
+    carol = root.create("dex-carol3", 100 * BASE_RESERVE)
+    carol.apply(carol.tx([carol.op_change_trust(usd)]))
+    env = bob.tx([bob.op(T.OperationType.PATH_PAYMENT_STRICT_RECEIVE,
+                         T.PathPaymentStrictReceiveOp.make(
+                             sendAsset=xlm, sendMax=1000,
+                             destination=T.muxed_account(carol.account_id),
+                             destAsset=usd, destAmount=700, path=[]))])
+    ok, result = bob.apply(env, expect_success=False)
+    code = result.result.value[0].value.value.type
+    assert code == T.PathPaymentStrictReceiveResultCode.\
+        PATH_PAYMENT_STRICT_RECEIVE_TOO_FEW_OFFERS
