@@ -1,0 +1,104 @@
+"""Flagship admission pipeline: single-device and sharded-mesh coverage.
+
+The driver validates ``__graft_entry__.dryrun_multichip`` externally; this
+suite exercises the same path in-process (conftest forces an 8-device virtual
+CPU mesh) and differential-checks ``admission_step`` outputs against the host
+oracles: the CPU ed25519 backend (ref src/crypto/SecretKey.cpp:428 seam) and
+the recursive quorum evaluator (ref src/scp/LocalNode.h:58-78 seam).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stellar_core_tpu.models.admission import (
+    AdmissionBatch,
+    admission_step,
+    dryrun_sharded,
+    example_batch,
+)
+from stellar_core_tpu.ops import quorum as Q
+
+
+def test_dryrun_sharded_8_devices():
+    assert len(jax.devices()) >= 8
+    dryrun_sharded(8)
+
+
+def test_admission_step_matches_host_oracles():
+    from stellar_core_tpu.crypto import ed25519 as ed
+
+    (batch,) = example_batch(n_sigs=8, n_nodes=4)
+    sig_ok, accept, ratify = jax.jit(admission_step)(batch)
+
+    def cpu_verify(b):
+        pk, sg, mg = (np.asarray(x) for x in (b.pubkeys, b.sigs, b.msgs))
+        return np.asarray(
+            [
+                ed.raw_verify(pk[i].tobytes(), sg[i].tobytes(), mg[i].tobytes())
+                for i in range(pk.shape[0])
+            ]
+        )
+
+    # differential vs the CPU backend (ref src/crypto/SecretKey.cpp:428 seam)
+    np.testing.assert_array_equal(np.asarray(sig_ok), cpu_verify(batch))
+    assert np.asarray(sig_ok).all()
+
+    # flip one byte: kernel and CPU backend must agree on the rejection too
+    bad_sigs = np.asarray(batch.sigs).copy()
+    bad_sigs[0, 0] ^= 0xFF
+    bad = batch._replace(sigs=jnp.asarray(bad_sigs))
+    sig_ok2, _, _ = jax.jit(admission_step)(bad)
+    np.testing.assert_array_equal(np.asarray(sig_ok2), cpu_verify(bad))
+    assert not bool(sig_ok2[0]) and np.asarray(sig_ok2[1:]).all()
+
+    # quorum tallies vs plain-python recursive reference over the 3-of-4 net
+    n_nodes = 4
+    qsets = [(3, list(range(n_nodes)), []) for _ in range(n_nodes)]
+
+    def ref_slice(qset, s):
+        thr, vals, _ = qset
+        return sum(1 for v in vals if v in s) >= thr
+
+    def ref_max_quorum(members):
+        cur = set(members)
+        while True:
+            nxt = {n for n in cur if ref_slice(qsets[n], cur)}
+            if nxt == cur:
+                return nxt
+            cur = nxt
+
+    voted = np.asarray(batch.voted)
+    accepted = np.asarray(batch.accepted)
+    for c in range(voted.shape[0]):
+        va = {i for i in range(n_nodes) if voted[c, i] or accepted[c, i]}
+        q = ref_max_quorum(va)
+        want_ratify = bool(q) and ref_slice(qsets[0], q)
+        acc_set = {i for i in range(n_nodes) if accepted[c, i]}
+        # v-blocking for 3-of-4: any 2 nodes
+        want_accept = len(acc_set) >= 2 or want_ratify
+        assert bool(ratify[c]) == want_ratify, c
+        assert bool(accept[c]) == want_accept, c
+
+
+def test_sharded_matches_unsharded():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    (batch,) = example_batch(n_sigs=16, n_nodes=4)
+    want = jax.jit(admission_step)(batch)
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    dp = NamedSharding(mesh, P("data"))
+    rep = NamedSharding(mesh, P())
+    sharded = AdmissionBatch(
+        jax.device_put(batch.pubkeys, dp),
+        jax.device_put(batch.sigs, dp),
+        jax.device_put(batch.msgs, dp),
+        Q.QSetTensor(*(jax.device_put(t, rep) for t in batch.qset)),
+        Q.QSetTensor(*(jax.device_put(t, rep) for t in batch.local_qset)),
+        jax.device_put(batch.voted, rep),
+        jax.device_put(batch.accepted, rep),
+    )
+    got = jax.jit(admission_step, out_shardings=(dp, rep, rep))(sharded)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
