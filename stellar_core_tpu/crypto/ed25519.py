@@ -45,9 +45,32 @@ def clear_verify_cache() -> None:
     _cache_misses = 0
 
 
+_SMALL_ORDER: frozenset | None = None
+
+
+def _small_order_encodings() -> frozenset:
+    # lazy: ed25519_ref derives the 8-torsion encodings at import time
+    global _SMALL_ORDER
+    if _SMALL_ORDER is None:
+        from . import ed25519_ref
+
+        _SMALL_ORDER = frozenset(ed25519_ref.SMALL_ORDER_ENCODINGS)
+    return _SMALL_ORDER
+
+
 def raw_verify(pubkey: bytes, signature: bytes, message: bytes) -> bool:
-    """Uncached single verify (OpenSSL via `cryptography`)."""
+    """Uncached single verify, libsodium crypto_sign_verify_detached
+    semantics (the reference's backend, src/crypto/SecretKey.cpp:454).
+
+    OpenSSL (via `cryptography`) implements the same cofactorless equation
+    and canonicality rejections but does NOT blacklist small-order A/R;
+    the explicit pre-filter below closes exactly that delta so the CPU
+    tier, the executable spec (crypto/ed25519_ref.py), and the TPU kernels
+    agree on every input."""
     if len(pubkey) != 32 or len(signature) != 64:
+        return False
+    so = _small_order_encodings()
+    if pubkey in so or signature[:32] in so:
         return False
     try:
         Ed25519PublicKey.from_public_bytes(pubkey).verify(signature, message)

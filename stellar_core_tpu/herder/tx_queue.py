@@ -10,7 +10,8 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from ..ledger.ledger_txn import LedgerTxn
-from ..transactions import TransactionFrame
+from ..transactions import TransactionFrame  # noqa: F401 (typing)
+from ..transactions.frame import tx_frame_from_envelope
 from ..transactions.frame import TC
 
 
@@ -45,7 +46,7 @@ class TransactionQueue:
         """ref tryAdd :130 — the north-star admission path."""
         network_id = self.app.config.network_id()
         try:
-            frame = TransactionFrame(network_id, env)
+            frame = tx_frame_from_envelope(network_id, env)
         except Exception:
             return self.ADD_STATUS_ERROR
         h = frame.full_hash()

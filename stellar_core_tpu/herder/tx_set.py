@@ -65,7 +65,9 @@ class TxSetFrame:
 
     @classmethod
     def make_from_wire(cls, network_id: bytes, xdr_tx_set) -> "TxSetFrame":
-        frames = [TransactionFrame(network_id, env)
+        from ..transactions.frame import tx_frame_from_envelope
+
+        frames = [tx_frame_from_envelope(network_id, env)
                   for env in xdr_tx_set.txs]
         return cls(network_id, xdr_tx_set.previousLedgerHash, frames)
 
@@ -161,19 +163,29 @@ class TxSetFrame:
         triples = []
         index = []
         for fi, f in enumerate(self.frames):
-            h = f.full_hash()
+            # a fee-bump contributes two signed payloads: the outer
+            # envelope (fee source sigs over the fee-bump hash) and the
+            # inner tx (its own hash + sigs)
+            payloads = [(f.full_hash(), f.signatures)]
+            inner = getattr(f, "inner_tx", None)
+            if inner is not None:
+                payloads.append((inner.full_hash(), inner.signatures))
             src = f.source_account_id()
-            # candidate signer keys: tx source + op sources (master keys);
-            # additional account signers resolve at check time via cache
-            # misses falling back to CPU verify
+            # candidate signer keys: tx source + fee source + op sources
+            # (master keys); additional account signers resolve at check
+            # time via cache misses falling back to CPU verify
             keys = {src}
+            fee_src = getattr(f, "fee_source_id", None)
+            if fee_src is not None:
+                keys.add(fee_src())
             for opf in f.op_frames:
                 keys.add(opf.source_account_id())
-            for i, ds in enumerate(f.signatures):
-                for pub in keys:
-                    if ds.hint == signature_hint(pub):
-                        triples.append((pub, ds.signature, h))
-                        index.append((fi, i, pub))
+            for h, sigs in payloads:
+                for i, ds in enumerate(sigs):
+                    for pub in keys:
+                        if ds.hint == signature_hint(pub):
+                            triples.append((pub, ds.signature, h))
+                            index.append((fi, i, pub))
         return triples, index
 
     def prevalidate_signatures(self, use_device: bool = True

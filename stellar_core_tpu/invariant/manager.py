@@ -57,25 +57,45 @@ class LedgerEntryIsValid(Invariant):
         return ""
 
 
+def _native_amount(entry) -> int:
+    """Native lumens held by a ledger entry: account balances, native
+    claimable balances, and native liquidity-pool reserves (ref
+    ConservationOfLumens.cpp ledgerEntryCoinDiff covering all types)."""
+    if entry is None:
+        return 0
+    d = entry.data
+    LE = T.LedgerEntryType
+    if d.type == LE.ACCOUNT:
+        return d.value.balance
+    if d.type == LE.CLAIMABLE_BALANCE:
+        if d.value.asset.type == T.AssetType.ASSET_TYPE_NATIVE:
+            return d.value.amount
+        return 0
+    if d.type == LE.LIQUIDITY_POOL:
+        cp = d.value.body.value
+        total = 0
+        if cp.params.assetA.type == T.AssetType.ASSET_TYPE_NATIVE:
+            total += cp.reserveA
+        if cp.params.assetB.type == T.AssetType.ASSET_TYPE_NATIVE:
+            total += cp.reserveB
+        return total
+    return 0
+
+
 class ConservationOfLumens(Invariant):
     """Native lumens only move, never appear (ref
-    src/invariant/ConservationOfLumens.cpp): per-tx delta of account
-    balances + feePool must be zero (inflation aside)."""
+    src/invariant/ConservationOfLumens.cpp): per-tx delta of native-
+    holding entries + feePool must equal the totalCoins delta."""
 
     NAME = "ConservationOfLumens"
 
     def check_on_tx_apply(self, ltx, frame, ok: bool) -> str:
         delta = 0
         for kb, entry in ltx._delta.items():
-            old = ltx.parent.get(kb)
-            new_bal = old_bal = 0
-            if entry is not None and \
-                    entry.data.type == T.LedgerEntryType.ACCOUNT:
-                new_bal = entry.data.value.balance
-            if old is not None and \
-                    old.data.type == T.LedgerEntryType.ACCOUNT:
-                old_bal = old.data.value.balance
-            delta += new_bal - old_bal
+            if kb.startswith(b"\xff"):
+                continue  # virtual sponsorship bookkeeping
+            delta += _native_amount(entry) - _native_amount(
+                ltx.parent.get(kb))
         hdr_new = ltx.header()
         hdr_old = ltx.parent.header()
         delta += hdr_new.feePool - hdr_old.feePool
@@ -85,7 +105,131 @@ class ConservationOfLumens(Invariant):
         return ""
 
 
-ALL_INVARIANTS = [LedgerEntryIsValid, ConservationOfLumens]
+class AccountSubEntriesCountIsValid(Invariant):
+    """numSubEntries tracks signers + owned subentry deltas
+    (ref src/invariant/AccountSubEntriesCountIsValid.cpp)."""
+
+    NAME = "AccountSubEntriesCountIsValid"
+
+    def check_on_tx_apply(self, ltx, frame, ok: bool) -> str:
+        LE = T.LedgerEntryType
+        sub_delta: dict = {}
+        signer_delta: dict = {}
+        count_delta: dict = {}
+        for kb, entry in ltx._delta.items():
+            if kb.startswith(b"\xff"):
+                continue
+            old = ltx.parent.get(kb)
+            for e, sign in ((entry, 1), (old, -1)):
+                if e is None:
+                    continue
+                d = e.data
+                if d.type == LE.ACCOUNT:
+                    aid = d.value.accountID.value
+                    count_delta[aid] = count_delta.get(aid, 0) + \
+                        sign * d.value.numSubEntries
+                    signer_delta[aid] = signer_delta.get(aid, 0) + \
+                        sign * len(d.value.signers)
+                elif d.type == LE.TRUSTLINE:
+                    aid = d.value.accountID.value
+                    mult = 2 if d.value.asset.type == \
+                        T.AssetType.ASSET_TYPE_POOL_SHARE else 1
+                    sub_delta[aid] = sub_delta.get(aid, 0) + sign * mult
+                elif d.type == LE.OFFER:
+                    aid = d.value.sellerID.value
+                    sub_delta[aid] = sub_delta.get(aid, 0) + sign
+                elif d.type == LE.DATA:
+                    aid = d.value.accountID.value
+                    sub_delta[aid] = sub_delta.get(aid, 0) + sign
+        for aid, cd in count_delta.items():
+            expect = sub_delta.get(aid, 0) + signer_delta.get(aid, 0)
+            # deleted accounts (merge) drop their remaining count wholesale
+            if ltx.get(_account_kb(aid)) is None:
+                continue
+            if cd != expect:
+                return (f"numSubEntries delta {cd} != owned subentry "
+                        f"delta {expect} for {aid[:4].hex()}")
+        return ""
+
+
+class SponsorshipCountIsValid(Invariant):
+    """Sum of numSponsoring deltas == sum of sponsored-reserve deltas
+    (entry sponsorships + account numSponsored; ref
+    src/invariant/SponsorshipCountIsValid.cpp)."""
+
+    NAME = "SponsorshipCountIsValid"
+
+    def check_on_tx_apply(self, ltx, frame, ok: bool) -> str:
+        from ..transactions.sponsorship import compute_multiplier, \
+            entry_sponsor
+
+        sponsoring = 0
+        sponsored_accounts = 0
+        entry_reserves = 0
+        for kb, entry in ltx._delta.items():
+            if kb.startswith(b"\xff"):
+                continue
+            old = ltx.parent.get(kb)
+            for e, sign in ((entry, 1), (old, -1)):
+                if e is None:
+                    continue
+                if e.data.type == T.LedgerEntryType.ACCOUNT:
+                    sponsoring += sign * U.num_sponsoring(e.data.value)
+                    sponsored_accounts += sign * U.num_sponsored(
+                        e.data.value)
+                if entry_sponsor(e) is not None:
+                    if e.data.type == T.LedgerEntryType.ACCOUNT:
+                        pass  # account entries count via numSponsored
+                    elif e.data.type == T.LedgerEntryType.CLAIMABLE_BALANCE:
+                        entry_reserves += sign * compute_multiplier(e)
+        if sponsoring != sponsored_accounts + entry_reserves:
+            return (f"numSponsoring delta {sponsoring} != numSponsored "
+                    f"{sponsored_accounts} + claimable-balance reserves "
+                    f"{entry_reserves}")
+        return ""
+
+
+class ConstantProductInvariant(Invariant):
+    """Pool invariant k = reserveA*reserveB never decreases across a swap
+    and reserves stay nonnegative (ref
+    src/invariant/ConstantProductInvariant.cpp)."""
+
+    NAME = "ConstantProductInvariant"
+
+    def check_on_tx_apply(self, ltx, frame, ok: bool) -> str:
+        for kb, entry in ltx._delta.items():
+            if kb.startswith(b"\xff") or entry is None:
+                continue
+            if entry.data.type != T.LedgerEntryType.LIQUIDITY_POOL:
+                continue
+            cp = entry.data.value.body.value
+            if cp.reserveA < 0 or cp.reserveB < 0 or \
+                    cp.totalPoolShares < 0:
+                return "negative pool reserve/shares"
+            old = ltx.parent.get(kb)
+            if old is None:
+                continue
+            ocp = old.data.value.body.value
+            # deposits/withdraws change totalPoolShares; swaps keep it —
+            # for swaps k must not decrease
+            if cp.totalPoolShares == ocp.totalPoolShares and \
+                    ocp.totalPoolShares != 0:
+                if cp.reserveA * cp.reserveB < ocp.reserveA * ocp.reserveB:
+                    return "constant product decreased on swap"
+        return ""
+
+
+def _account_kb(account_id: bytes) -> bytes:
+    k = T.LedgerKey.make(
+        T.LedgerEntryType.ACCOUNT,
+        T.LedgerKey.arms[T.LedgerEntryType.ACCOUNT][1].make(
+            accountID=T.account_id(account_id)))
+    return T.LedgerKey.encode(k)
+
+
+ALL_INVARIANTS = [LedgerEntryIsValid, ConservationOfLumens,
+                  AccountSubEntriesCountIsValid, SponsorshipCountIsValid,
+                  ConstantProductInvariant]
 
 
 class InvariantManager:

@@ -218,9 +218,13 @@ class LedgerManager:
         whose deletion may annihilate) from updates of entries living in
         deeper bucket levels (LIVEENTRY, whose deletion needs a persistent
         tombstone) — the root still holds pre-close state here."""
+        from .ledger_txn import VIRTUAL_PREFIX
+
         return [
             (kb, entry, self.root.get(kb) is not None)
             for kb, entry in sorted(ltx._delta.items())
+            # sponsorship bookkeeping entries never reach the bucket list
+            if not kb.startswith(VIRTUAL_PREFIX)
         ]
 
     def _apply_upgrade(self, header, upgrade):

@@ -24,6 +24,25 @@ class LedgerTxnError(Exception):
     pass
 
 
+# -- virtual (never-committed) entries ---------------------------------------
+# The reference tracks active sponsorships as *internal* ledger entries that
+# live only inside LedgerTxn layers (ref src/ledger/InternalLedgerEntry.h:16-17,
+# SPONSORSHIP / SPONSORSHIP_COUNTER) so they roll back with the op/tx that
+# created them and must all be gone by commit time.  Virtual keys use a \xff
+# prefix, which can never collide with an XDR-encoded LedgerKey (those start
+# with a \x00 byte of the 4-byte big-endian type discriminant).
+
+VIRTUAL_PREFIX = b"\xff"
+
+
+def sponsorship_key(sponsored_id: bytes) -> bytes:
+    return b"\xffSP" + sponsored_id
+
+
+def sponsorship_counter_key(sponsoring_id: bytes) -> bytes:
+    return b"\xffSC" + sponsoring_id
+
+
 def entry_to_key(entry) -> object:
     """LedgerEntry -> LedgerKey value."""
     d = entry.data
@@ -155,6 +174,31 @@ class LedgerTxn(AbstractLedgerTxn):
             raise LedgerTxnError("erasing nonexistent entry")
         self._delta[kb] = None
 
+    # -- virtual entries (sponsorship bookkeeping; see module header) -------
+
+    def put_virtual(self, kb: bytes, value) -> None:
+        self._check_open()
+        assert kb.startswith(VIRTUAL_PREFIX)
+        self._delta[kb] = value
+
+    def erase_virtual(self, kb: bytes) -> None:
+        self._check_open()
+        assert kb.startswith(VIRTUAL_PREFIX)
+        self._delta[kb] = None
+
+    def live_virtual_keys(self, prefix: bytes) -> List[bytes]:
+        """Virtual keys with a live (non-erased) value visible from this
+        layer, walking the parent chain (root never has any)."""
+        self._check_open()
+        seen: Dict[bytes, Optional[object]] = {}
+        layer = self
+        while isinstance(layer, LedgerTxn):
+            for kb, v in layer._delta.items():
+                if kb.startswith(prefix) and kb not in seen:
+                    seen[kb] = v
+            layer = layer.parent
+        return [kb for kb, v in seen.items() if v is not None]
+
     # -- lifecycle ---------------------------------------------------------
 
     def commit(self) -> None:
@@ -199,6 +243,8 @@ class LedgerTxn(AbstractLedgerTxn):
         out = []
         CT = T.LedgerEntryChangeType
         for kb, new in sorted(self._delta.items()):
+            if kb.startswith(VIRTUAL_PREFIX):
+                continue  # sponsorship bookkeeping never reaches meta
             old = self.parent.get(kb)
             if old is not None:
                 out.append(T.LedgerEntryChange.make(
@@ -285,6 +331,12 @@ class LedgerTxnRoot(AbstractLedgerTxn):
                            header) -> None:
         cur = self.db.cursor()
         for kb, entry in delta.items():
+            if kb.startswith(VIRTUAL_PREFIX):
+                if entry is not None:
+                    raise LedgerTxnError(
+                        "live virtual entry at root commit (unclosed "
+                        "sponsorship)")
+                continue
             if entry is None:
                 cur.execute("DELETE FROM ledgerentries WHERE key = ?", (kb,))
                 cur.execute("DELETE FROM offers WHERE key = ?", (kb,))

@@ -259,13 +259,25 @@ def _verify_impl(pubkeys, sigs, msgs):
     return match & a_ok & r_ok & s_ok
 
 
+_SMALL_ORDER_NP = np.frombuffer(
+    b"".join(ref.SMALL_ORDER_ENCODINGS), dtype=np.uint8
+).reshape(len(ref.SMALL_ORDER_ENCODINGS), 32)
+
+
 @partial(jax.jit, static_argnames=())
 def verify_batch(pubkeys: jnp.ndarray, sigs: jnp.ndarray,
                  msgs: jnp.ndarray) -> jnp.ndarray:
     """Batched ed25519 verify.
 
     pubkeys: (N, 32) uint8; sigs: (N, 64) uint8; msgs: (N, 32) uint8
-    -> (N,) bool, bit-identical accept/reject to the CPU reference path.
-    """
-    return _verify_impl(jnp.asarray(pubkeys), jnp.asarray(sigs),
-                        jnp.asarray(msgs))
+    -> (N,) bool, bit-identical accept/reject to the CPU reference path
+    (libsodium semantics incl. the small-order blacklist)."""
+    pubkeys = jnp.asarray(pubkeys)
+    sigs = jnp.asarray(sigs)
+    msgs = jnp.asarray(msgs)
+    so = jnp.asarray(_SMALL_ORDER_NP)
+    small_a = jnp.any(jnp.all(pubkeys[:, None, :] == so[None], axis=-1),
+                      axis=-1)
+    small_r = jnp.any(jnp.all(sigs[:, None, :32] == so[None], axis=-1),
+                      axis=-1)
+    return _verify_impl(pubkeys, sigs, msgs) & ~small_a & ~small_r

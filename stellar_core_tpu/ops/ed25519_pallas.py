@@ -1,0 +1,524 @@
+"""Batched ed25519 verification as a Pallas TPU kernel — the hot path of
+BASELINE config #2 (tx-signature verifies/sec on a 100k-tx TxSetFrame).
+
+Why Pallas (and not the pure-XLA kernel in ops/ed25519_kernel.py): profiling
+on TPU v5e showed XLA scheduling the chained point operations at ~100M
+int32-muls/s with wild per-program variance (point_double chains compiled
+1000x slower than point_add chains), leaving the verify rate stuck ~3x over
+the CPU baseline for two rounds.  A hand-written kernel controls what XLA
+would not: VMEM residency of the whole ladder state, full 128-lane
+occupancy (batch on the lane axis, limbs on sublanes), and static unrolling
+of the field convolution.
+
+Layout: a field element is int32[22, B] — 22 little-endian 12-bit limbs
+(radix 2^12, same representation and mul-safety bounds as ops/field25519.py)
+on the sublane axis, B signatures on the lane axis.  All carries use
+arithmetic shifts; products of mul-safe limbs stay < 2^31 (see
+field25519.py's bound derivation).
+
+Work split per signature batch:
+- outside (XLA): SHA-512(R||A||M) mod L and digit extraction
+  (ops/sha512.py — measured fast), byte->limb unpack, s-canonicality,
+  A/R canonicality (y < p), small-order blacklist byte compare
+  (crypto/ed25519_ref.py SMALL_ORDER_ENCODINGS);
+- inside (this kernel): A decompression (sqrt chain), the 64x4-bit
+  shared-doubling ladder R' = [s]B + [h](-A) with 16-entry window tables,
+  and the canonical-encoding comparison against R.
+
+Acceptance semantics are libsodium crypto_sign_verify_detached
+(ref src/crypto/SecretKey.cpp:454); the executable spec is
+crypto/ed25519_ref.py and the differential tests pin all three
+implementations (spec / CPU backend / this kernel) together, including the
+small-order and non-canonical edge vectors.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..crypto import ed25519_ref as ref
+from . import field25519 as F
+from . import scalar25519 as S
+from .sha512 import sha512_96
+
+NL = F.NLIMBS          # 22 limbs
+RADIX = F.RADIX        # 12
+MASK = F.MASK
+FOLD = F.FOLD          # 19 << 9
+BLOCK = 256            # signatures per pallas program (lanes = 128 x 2)
+
+# ---------------------------------------------------------------------------
+# constants (host side)
+# ---------------------------------------------------------------------------
+
+_D_LIMBS = F.int_to_limbs(ref.D)
+_SQRT_M1_LIMBS = F.int_to_limbs(ref.SQRT_M1)
+_D2_LIMBS = F.int_to_limbs(2 * ref.D % F.P)
+
+
+def _b_table_np() -> np.ndarray:
+    """(16, 4, 22) int32: [0..15]*B in extended affine-ish form (Z=1)."""
+    rows = []
+    pt = ref.IDENT
+    for _ in range(16):
+        x, y, z, t = pt
+        zi = pow(z, F.P - 2, F.P)
+        xa, ya = x * zi % F.P, y * zi % F.P
+        rows.append(np.stack([
+            F.int_to_limbs(xa), F.int_to_limbs(ya),
+            F.int_to_limbs(1), F.int_to_limbs(xa * ya % F.P)]))
+        pt = ref.point_add(pt, ref.to_extended(ref.B))
+    return np.stack(rows)
+
+
+_B_TABLE = _b_table_np()
+
+
+def _p_shift_np() -> np.ndarray:
+    """p << 12 in limb form (freeze bias; see field25519._p_shift)."""
+    v = F.P << RADIX
+    out = np.zeros(NL, dtype=np.int64)
+    for i in range(NL):
+        out[i] = (v >> (RADIX * i)) & MASK
+    hi = v >> (RADIX * NL)
+    limbs = out.astype(np.int32)
+    limbs[0] += hi * FOLD
+    return limbs
+
+
+def _consts_np() -> np.ndarray:
+    """All in-kernel array constants packed as one (72, 24) int32 input
+    (pallas_call forbids captured array constants): rows 0..63 the flat
+    [0..15]*B window table (16 points x 4 coords), 64 p<<12 (freeze bias),
+    65 d, 66 sqrt(-1), 67 2d, 68 one; each row 22 limbs + 2 zero pads."""
+    rows = np.zeros((72, 24), dtype=np.int32)
+    rows[:64, :22] = _B_TABLE.reshape(64, 22)
+    rows[64, :22] = _p_shift_np()
+    rows[65, :22] = _D_LIMBS
+    rows[66, :22] = _SQRT_M1_LIMBS
+    rows[67, :22] = _D2_LIMBS
+    rows[68, :22] = F.int_to_limbs(1)
+    return rows
+
+
+class _KC:
+    """In-kernel constant views extracted from the consts input block."""
+
+    def __init__(self, consts):
+        self.btab = [[consts[p * 4 + c, :NL][:, None] for c in range(4)]
+                     for p in range(16)]
+        self.p_shift = consts[64, :NL][:, None]
+        self.d = consts[65, :NL][:, None]
+        self.sqrt_m1 = consts[66, :NL][:, None]
+        self.d2 = consts[67, :NL][:, None]
+        self.one = consts[68, :NL][:, None]
+
+
+# ---------------------------------------------------------------------------
+# field ops on int32[..., NL, B] values (inside-kernel helpers)
+# ---------------------------------------------------------------------------
+
+def _weak_carry(x, passes: int = 2):
+    """Parallel carry passes; limb-21 carry folds to limb 0 with weight
+    19*2^9 (2^264 == FOLD * 2^252... see field25519.weak_carry)."""
+    for _ in range(passes):
+        carry = x >> RADIX
+        lo = x - (carry << RADIX)
+        x = lo + jnp.concatenate([carry[NL - 1:NL] * FOLD, carry[:NL - 1]],
+                                 axis=0)
+    return x
+
+
+def _row_add(x, i: int, v):
+    """x with row i incremented by v (no scatter: concat-based, static i;
+    zero-size slices are not valid mosaic vectors, so skip empty parts)."""
+    parts = []
+    if i > 0:
+        parts.append(x[:i])
+    parts.append((x[i] + v)[None, :])
+    if i + 1 < x.shape[0]:
+        parts.append(x[i + 1:])
+    return jnp.concatenate(parts, axis=0)
+
+
+def _pad_rows(x, before: int, after: int):
+    """Zero-pad on the sublane axis via concatenate (mosaic lowers
+    concatenate; jnp.pad/scatter do not lower)."""
+    parts = []
+    if before:
+        parts.append(jnp.zeros((before, x.shape[1]), jnp.int32))
+    parts.append(x)
+    if after:
+        parts.append(jnp.zeros((after, x.shape[1]), jnp.int32))
+    return jnp.concatenate(parts, axis=0) if len(parts) > 1 else x
+
+
+def _conv(a, b):
+    """Schoolbook 22x22 convolution -> (44, B); mul-safe inputs.
+
+    Pad-and-sum form: scatter-add is not lowerable in Pallas TPU, and the
+    padded full-width adds keep every op on whole (44, B) tiles."""
+    terms = []
+    for i in range(NL):
+        prod = a[i:i + 1, :] * b  # (22, B)
+        terms.append(_pad_rows(prod, i, NL - i))
+    acc = terms[0]
+    for t in terms[1:]:
+        acc = acc + t
+    return acc
+
+
+def _reduce_product(c):
+    """(44, B) -> (22, B) mul-safe (mirrors field25519._reduce_product)."""
+    c = _pad_rows(c, 0, 2)  # width 46
+    for _ in range(2):
+        carry = c >> RADIX
+        lo = c - (carry << RADIX)
+        c = lo + _pad_rows(carry[:-1], 1, 0)
+    out = _pad_rows(c[:NL], 0, 1) + FOLD * c[NL:45]
+    for _ in range(3):
+        x = out[:NL]
+        carry = x >> RADIX
+        lo = x - (carry << RADIX)
+        top = out[NL] + carry[NL - 1]
+        body = lo + _pad_rows(carry[:NL - 1], 1, 0)
+        body = _row_add(body, 0, FOLD * top)
+        out = _pad_rows(body, 0, 1)
+    return out[:NL]
+
+
+def _mul(a, b):
+    return _reduce_product(_conv(a, b))
+
+
+def _sqr(a):
+    return _mul(a, a)
+
+
+def _add(a, b):
+    return _weak_carry(a + b)
+
+
+def _sub(a, b):
+    return _weak_carry(a - b)
+
+
+def _sqr_times(a, n: int):
+    if n < 4:
+        for _ in range(n):
+            a = _sqr(a)
+        return a
+    return jax.lax.fori_loop(0, n, lambda _, x: _sqr(x), a,
+                             unroll=False)
+
+
+def _pow_250_1(z):
+    """z^(2^250 - 1) (ref10 addition chain, as in field25519)."""
+    z2 = _sqr(z)
+    z9 = _mul(_sqr_times(z2, 2), z)
+    z11 = _mul(z9, z2)
+    z_5_0 = _mul(_sqr(z11), z9)
+    z_10_0 = _mul(_sqr_times(z_5_0, 5), z_5_0)
+    z_20_0 = _mul(_sqr_times(z_10_0, 10), z_10_0)
+    z_40_0 = _mul(_sqr_times(z_20_0, 20), z_20_0)
+    z_50_0 = _mul(_sqr_times(z_40_0, 10), z_10_0)
+    z_100_0 = _mul(_sqr_times(z_50_0, 50), z_50_0)
+    z_200_0 = _mul(_sqr_times(z_100_0, 100), z_100_0)
+    z_250_0 = _mul(_sqr_times(z_200_0, 50), z_50_0)
+    return z_250_0, z11
+
+
+def _inv(z):
+    z_250_0, z11 = _pow_250_1(z)
+    return _mul(_sqr_times(z_250_0, 5), z11)
+
+
+def _pow22523(z):
+    z_250_0, _ = _pow_250_1(z)
+    return _mul(_sqr_times(z_250_0, 2), z)
+
+
+def _carry_seq(x, width: int):
+    """Left-to-right sequential carry (unrolled; tiny per-limb body)."""
+    c = jnp.zeros_like(x[0])
+    rows = []
+    for i in range(width - 1):
+        s = x[i] + c
+        c = s >> RADIX
+        rows.append(s - (c << RADIX))
+    rows.append(x[width - 1] + c)
+    return jnp.stack(rows, axis=0)
+
+
+def _freeze(a, C):
+    """Canonical limbs in [0, MASK], value in [0, p) (mirrors
+    field25519.freeze)."""
+    x = a + C.p_shift
+    x = _weak_carry(x, 2)
+    x = _carry_seq(x, NL)
+    for _ in range(2):
+        top_hi = x[NL - 1] >> RADIX
+        x = _row_add(x, NL - 1, -(top_hi << RADIX))
+        x = _row_add(x, 0, top_hi * FOLD)
+        x = _carry_seq(x, NL)
+    for _ in range(2):
+        hi = x[NL - 1] >> 3
+        x = _row_add(x, NL - 1, -(hi << 3))
+        x = _row_add(x, 0, hi * 19)
+        x = _carry_seq(x, NL)
+    t = _row_add(x, 0, jnp.int32(19))
+    t = _carry_seq(t, NL)
+    ge = (t[NL - 1] >> 3) > 0
+    t_mod = jnp.concatenate([t[:NL - 1], (t[NL - 1] & 7)[None, :]], axis=0)
+    return jnp.where(ge[None, :], t_mod, x)
+
+
+def _is_zero(a, C):
+    return jnp.all(_freeze(a, C) == 0, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# point ops: tuples of 4 limb arrays (X, Y, Z, T), extended coordinates
+# ---------------------------------------------------------------------------
+
+def _point_add(p, q, C):
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = _mul(_sub(y1, x1), _sub(y2, x2))
+    b = _mul(_add(y1, x1), _add(y2, x2))
+    c = _mul(_mul(t1, t2), C.d2)
+    d = _mul(z1, z2)
+    d = _weak_carry(d + d)
+    e = b - a
+    f = d - c
+    g = d + c
+    h = b + a
+    return (_mul(e, f), _mul(g, h), _mul(f, g), _mul(e, h))
+
+
+def _point_double(p):
+    x1, y1, z1, _ = p
+    a = _sqr(x1)
+    b = _sqr(y1)
+    zz = _sqr(z1)
+    c = zz + zz
+    h = a + b
+    xy = _add(x1, y1)
+    e = _weak_carry(h - _sqr(xy))
+    g = a - b
+    f = _weak_carry(c + g)
+    h = _weak_carry(h)
+    g = _weak_carry(g)
+    return (_mul(e, f), _mul(g, h), _mul(f, g), _mul(e, h))
+
+
+def _point_neg(p):
+    x, y, z, t = p
+    return (_weak_carry(-x), y, z, _weak_carry(-t))
+
+
+def _ident_pt(bsz):
+    zero = jnp.zeros((NL, bsz), dtype=jnp.int32)
+    one = jnp.concatenate(
+        [jnp.ones((1, bsz), dtype=jnp.int32),
+         jnp.zeros((NL - 1, bsz), dtype=jnp.int32)], axis=0)
+    return (zero, one, one, zero)
+
+
+# ---------------------------------------------------------------------------
+# the kernel
+# ---------------------------------------------------------------------------
+
+SL = 24  # padded sublane rows per table entry (3 int32 tiles)
+
+
+def _verify_kernel(consts_ref, ya_ref, yr_ref, sdig_ref, hdig_ref, out_ref,
+                   tabx_ref, taby_ref, tabz_ref, tabt_ref):
+    """One batch block: decompress A, ladder, compare to R.
+
+    consts: (72, 24) packed constants (see _consts_np); ya/yr: (24, B)
+    int32 — rows 0..21 the y-limbs of A / R (bit 255 cleared), row 22 the
+    sign bit, row 23 zero padding (24 = 3 int32 sublane tiles); sdig/hdig:
+    (64, B) 4-bit digits of s and h (LSB-first); out: (8, B) int32 1/0
+    broadcast over sublanes."""
+    bsz = ya_ref.shape[1]
+    C = _KC(consts_ref[...])
+    ya24 = ya_ref[...]
+    y = ya24[:NL]
+    sign = ya24[NL]
+
+    # --- decompress A (mirrors ed25519_ref._recover_x) ---
+    yy = _sqr(y)
+    u = _weak_carry(yy - C.one)
+    v = _add(_mul(yy, C.d), C.one)
+    v3 = _mul(_sqr(v), v)
+    v7 = _mul(_sqr(v3), v)
+    x = _mul(_mul(u, v3), _pow22523(_mul(u, v7)))
+    vxx = _mul(v, _sqr(x))
+    on_curve_direct = _is_zero(_sub(vxx, u), C)
+    on_curve_flipped = _is_zero(_add(vxx, u), C)
+    x = jnp.where(on_curve_flipped[None, :], _mul(x, C.sqrt_m1), x)
+    a_ok = on_curve_direct | on_curve_flipped
+    xf = _freeze(x, C)
+    x_is_zero = jnp.all(xf == 0, axis=0)
+    a_ok = a_ok & ~(x_is_zero & (sign == 1))
+    flip = ((xf[0] & 1) != sign)[None, :]
+    x = jnp.where(flip, _weak_carry(-x), x)
+    t = _mul(x, y)
+    a_pt = (x, y, _ident_pt(bsz)[1], t)
+
+    # --- window table for -A: [0..15]*(-A), built once per block into the
+    # VMEM scratch refs (a statically-unrolled build would inline 14 point
+    # adds ≈ 126 field muls into the trace and blow up mosaic compile
+    # time; the fori_loop body traces one add) ---
+    neg_a = _point_neg(a_pt)
+    tab_refs = (tabx_ref, taby_ref, tabz_ref, tabt_ref)
+    ident = _ident_pt(bsz)
+    for c in range(4):
+        tab_refs[c][0:SL, :] = _pad_rows(ident[c], 0, SL - NL)
+        tab_refs[c][SL:2 * SL, :] = _pad_rows(neg_a[c], 0, SL - NL)
+
+    def build(i, acc_pt):
+        nxt = _point_add(acc_pt, neg_a, C)
+        for c in range(4):
+            pl.store(tab_refs[c],
+                     (pl.dslice((i + 2) * SL, SL), slice(None)),
+                     _pad_rows(nxt[c], 0, SL - NL))
+        return nxt
+
+    jax.lax.fori_loop(0, 14, build, neg_a, unroll=False)
+
+    # --- MSB-first shared-doubling ladder over 64 4-bit digit slots ---
+    def select_rt(dig):
+        sel = [jnp.zeros((NL, bsz), jnp.int32) for _ in range(4)]
+        for w in range(16):
+            m = (dig == w).astype(jnp.int32)[None, :]
+            for c in range(4):
+                row = tab_refs[c][w * SL:w * SL + NL, :]
+                sel[c] = sel[c] + m * row
+        return tuple(sel)
+
+    def select_const(dig):
+        sel = [jnp.zeros((NL, bsz), jnp.int32) for _ in range(4)]
+        for w in range(16):
+            m = (dig == w).astype(jnp.int32)[None, :]
+            for c in range(4):
+                sel[c] = sel[c] + m * C.btab[w][c]
+        return tuple(sel)
+
+    sdig = sdig_ref[...]
+    hdig = hdig_ref[...]
+
+    def step(i, acc_pt):
+        # digit index 63-i (MSB first)
+        sd = jax.lax.dynamic_index_in_dim(sdig, 63 - i, axis=0,
+                                          keepdims=False)
+        hd = jax.lax.dynamic_index_in_dim(hdig, 63 - i, axis=0,
+                                          keepdims=False)
+        for _ in range(4):
+            acc_pt = _point_double(acc_pt)
+        acc_pt = _point_add(acc_pt, select_const(sd), C)
+        acc_pt = _point_add(acc_pt, select_rt(hd), C)
+        return acc_pt
+
+    accp = jax.lax.fori_loop(0, 64, step, _ident_pt(bsz), unroll=False)
+
+    # --- encode R' and compare against R bytes (limb-space compare) ---
+    zi = _inv(accp[2])
+    xa = _freeze(_mul(accp[0], zi), C)
+    ya_out = _freeze(_mul(accp[1], zi), C)
+    yr24 = yr_ref[...]
+    match = jnp.all(ya_out == _freeze(yr24[:NL], C), axis=0)
+    match = match & ((xa[0] & 1) == yr24[NL])
+    ok = (match & a_ok).astype(jnp.int32)
+    out_ref[...] = jnp.broadcast_to(ok[None, :], (8, bsz))
+
+
+# ---------------------------------------------------------------------------
+# host-side wrapper
+# ---------------------------------------------------------------------------
+
+_SMALL_ORDER = np.frombuffer(
+    b"".join(ref.SMALL_ORDER_ENCODINGS), dtype=np.uint8
+).reshape(len(ref.SMALL_ORDER_ENCODINGS), 32)
+
+
+def _canonical_y(limbs):
+    """bool (..,): y < p given (.., 22) limbs of the 255-bit y field."""
+    t = F._carry_full(limbs.at[..., 0].add(19), NL)
+    return (t[..., NL - 1] >> 3) == 0
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def verify_batch(pubkeys, sigs, msgs, interpret: bool = False):
+    """Batched ed25519 verify: (N,32)x(N,64)x(N,32) uint8 -> (N,) bool.
+
+    Bit-identical accept/reject to crypto/ed25519_ref.verify (libsodium
+    semantics).  N is padded up to a BLOCK multiple internally."""
+    pubkeys = jnp.asarray(pubkeys)
+    sigs = jnp.asarray(sigs)
+    msgs = jnp.asarray(msgs)
+    n = pubkeys.shape[0]
+    npad = -n % BLOCK
+    if npad:
+        pubkeys = jnp.pad(pubkeys, ((0, npad), (0, 0)))
+        sigs = jnp.pad(sigs, ((0, npad), (0, 0)))
+        msgs = jnp.pad(msgs, ((0, npad), (0, 0)))
+    ntot = n + npad
+
+    r_bytes = sigs[:, :32]
+    s_bytes = sigs[:, 32:]
+
+    # outside-kernel scalar/byte work (cheap in XLA)
+    digest = sha512_96(jnp.concatenate([r_bytes, pubkeys, msgs], axis=-1))
+    h_digits = S.to_digits4(S.reduce512(digest))      # (N, 64)
+    s_digits = S.to_digits4(S.scalar_from_bytes(s_bytes))
+    s_ok = S.is_canonical(s_bytes)
+
+    def y_limbs_and_sign(enc):
+        bits = F.bytes_to_bits(enc)
+        sign = bits[..., 255]
+        y = bits.at[..., 255].set(0) @ F._bits_to_limbs_mat()
+        return y, sign
+
+    ya, sign_a = y_limbs_and_sign(pubkeys)
+    yr, sign_r = y_limbs_and_sign(r_bytes)
+    canon = _canonical_y(ya) & _canonical_y(yr)
+
+    so = jnp.asarray(_SMALL_ORDER)  # (K, 32)
+    small_a = jnp.any(jnp.all(pubkeys[:, None, :] == so[None], axis=-1),
+                      axis=-1)
+    small_r = jnp.any(jnp.all(r_bytes[:, None, :] == so[None], axis=-1),
+                      axis=-1)
+
+    def pack24(y_limbs, sign):
+        # (N, 22) + (N,) -> (24, N): limbs, sign row, zero row
+        return jnp.concatenate(
+            [y_limbs.T.astype(jnp.int32),
+             sign[None, :].astype(jnp.int32),
+             jnp.zeros((1, ntot), jnp.int32)], axis=0)
+
+    grid = (ntot // BLOCK,)
+    spec_c = pl.BlockSpec((72, 24), lambda i: (0, 0))
+    spec_l = pl.BlockSpec((24, BLOCK), lambda i: (0, i))
+    spec_d = pl.BlockSpec((64, BLOCK), lambda i: (0, i))
+    spec_o = pl.BlockSpec((8, BLOCK), lambda i: (0, i))
+    ok_core = pl.pallas_call(
+        _verify_kernel,
+        grid=grid,
+        in_specs=[spec_c, spec_l, spec_l, spec_d, spec_d],
+        out_specs=spec_o,
+        out_shape=jax.ShapeDtypeStruct((8, ntot), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((16 * SL, BLOCK), jnp.int32)
+                        for _ in range(4)],
+        interpret=interpret,
+    )(jnp.asarray(_consts_np()), pack24(ya, sign_a), pack24(yr, sign_r),
+      s_digits.T.astype(jnp.int32), h_digits.T.astype(jnp.int32))
+
+    ok = (ok_core[0] == 1) & s_ok & canon & ~small_a & ~small_r
+    return ok[:n]

@@ -247,16 +247,19 @@ class TransactionFrame:
         return TC.txSUCCESS
 
     def check_valid(self, ltx_parent, current_seq: int = 0,
-                    verify: Optional[Callable] = None) -> ValidationResult:
+                    verify: Optional[Callable] = None,
+                    charge_fee: bool = True) -> ValidationResult:
         """Full admission-time validity (ref checkValid :1339): structure,
         preconditions, fee, seqnum, signatures for the tx AND every op.
         Read-only — runs in a throwaway LedgerTxn.  ``current_seq``
         validates a tx whose predecessors (consuming seqs up to that value)
-        are already in the candidate set."""
+        are already in the candidate set.  ``charge_fee=False`` is the
+        fee-bump inner-tx mode (ref checkValidWithOptionallyChargedFee)."""
         with LedgerTxn(ltx_parent) as ltx:
             checker = SignatureChecker(
                 self.full_hash(), self.signatures, verify)
-            res = self.common_valid(ltx, apply_seq=False, charge_fee=True,
+            res = self.common_valid(ltx, apply_seq=False,
+                                    charge_fee=charge_fee,
                                     current_seq=current_seq)
             if res != TC.txSUCCESS:
                 self.result_code = res
@@ -358,6 +361,19 @@ class TransactionFrame:
                 return (False,
                         self._make_result(TC.txBAD_AUTH_EXTRA, []),
                         _empty_meta())
+            if success:
+                # every BEGIN_SPONSORING_FUTURE_RESERVES must be closed by
+                # tx end (ref TransactionFrame applyOperations ->
+                # txBAD_SPONSORSHIP)
+                from .sponsorship import any_active_sponsorships
+
+                if any_active_sponsorships(tx_ltx):
+                    success = False
+                    self.result_code = TC.txBAD_SPONSORSHIP
+                    tx_ltx.rollback()
+                    return (False,
+                            self._make_result(TC.txBAD_SPONSORSHIP, []),
+                            _empty_meta())
             if success:
                 if invariant_check is not None:
                     invariant_check(tx_ltx, self, True)

@@ -9,6 +9,13 @@ from .account_ops import (  # noqa: F401
     SetTrustLineFlagsOpFrame,
 )
 from .base import OperationFrame, op_error, op_inner  # noqa: F401
+from .claimable_balance import (  # noqa: F401
+    ClaimClaimableBalanceOpFrame, ClawbackClaimableBalanceOpFrame,
+    CreateClaimableBalanceOpFrame,
+)
+from .liquidity_pool_ops import (  # noqa: F401
+    LiquidityPoolDepositOpFrame, LiquidityPoolWithdrawOpFrame,
+)
 from .offers import (  # noqa: F401
     CreatePassiveSellOfferOpFrame, ManageBuyOfferOpFrame,
     ManageSellOfferOpFrame, PathPaymentStrictReceiveOpFrame,
@@ -17,10 +24,22 @@ from .offers import (  # noqa: F401
 from .payments import (  # noqa: F401
     AccountMergeOpFrame, CreateAccountOpFrame, PaymentOpFrame,
 )
+from .sponsorship_ops import (  # noqa: F401
+    BeginSponsoringFutureReservesOpFrame,
+    EndSponsoringFutureReservesOpFrame, RevokeSponsorshipOpFrame,
+)
 
 OT = T.OperationType
 
 _REGISTRY = {
+    OT.CREATE_CLAIMABLE_BALANCE: CreateClaimableBalanceOpFrame,
+    OT.CLAIM_CLAIMABLE_BALANCE: ClaimClaimableBalanceOpFrame,
+    OT.CLAWBACK_CLAIMABLE_BALANCE: ClawbackClaimableBalanceOpFrame,
+    OT.BEGIN_SPONSORING_FUTURE_RESERVES: BeginSponsoringFutureReservesOpFrame,
+    OT.END_SPONSORING_FUTURE_RESERVES: EndSponsoringFutureReservesOpFrame,
+    OT.REVOKE_SPONSORSHIP: RevokeSponsorshipOpFrame,
+    OT.LIQUIDITY_POOL_DEPOSIT: LiquidityPoolDepositOpFrame,
+    OT.LIQUIDITY_POOL_WITHDRAW: LiquidityPoolWithdrawOpFrame,
     OT.CREATE_ACCOUNT: CreateAccountOpFrame,
     OT.PAYMENT: PaymentOpFrame,
     OT.ACCOUNT_MERGE: AccountMergeOpFrame,

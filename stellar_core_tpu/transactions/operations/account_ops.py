@@ -72,27 +72,31 @@ class ManageDataOpFrame(OperationFrame):
         acc = acc_entry.data.value
         existing = ltx.load_data(src_id, self.body.dataName)
 
+        from .. import sponsorship as SP
+        from .base import op_error
+
         if self.body.dataValue is None:
             # delete
             if existing is None:
                 return self._res(C.MANAGE_DATA_NAME_NOT_FOUND)
+            SP.remove_entry_with_possible_sponsorship(ltx, existing, src_id)
             ltx.erase(entry_to_key(existing))
-            acc = acc._replace(numSubEntries=acc.numSubEntries - 1)
-            _put_account(ltx, acc_entry, acc)
             return self._res(C.MANAGE_DATA_SUCCESS)
 
         if existing is None:
-            # create: needs a subentry reserve
-            acc2 = acc._replace(numSubEntries=acc.numSubEntries + 1)
-            if acc.balance < U.min_balance(header, acc2):
-                return self._res(C.MANAGE_DATA_LOW_RESERVE)
             de = T.DataEntry.make(
                 accountID=T.account_id(src_id),
                 dataName=self.body.dataName,
                 dataValue=self.body.dataValue,
                 ext=T.DataEntry.fields[3][1].make(0))
-            ltx.put(U.wrap_entry(T.LedgerEntryType.DATA, de))
-            _put_account(ltx, acc_entry, acc2)
+            new_entry = U.wrap_entry(T.LedgerEntryType.DATA, de)
+            res, new_entry = SP.create_entry_with_possible_sponsorship(
+                ltx, new_entry, src_id, owner_entry=acc_entry)
+            err = SP.map_sponsorship_result(
+                res, self._res(C.MANAGE_DATA_LOW_RESERVE))
+            if err is not None:
+                return err
+            ltx.put(new_entry)
         else:
             de = existing.data.value._replace(dataValue=self.body.dataValue)
             ltx.put(existing._replace(
@@ -184,7 +188,11 @@ class SetOptionsOpFrame(OperationFrame):
             acc = acc._replace(homeDomain=b.homeDomain)
 
         if b.signer is not None:
+            from .. import sponsorship as SP
+            from .base import op_error
+
             signers = list(acc.signers)
+            sids = SP.signer_sponsoring_ids(acc)
             skey_b = T.SignerKey.encode(b.signer.key)
             idx = next(
                 (i for i, s in enumerate(signers)
@@ -192,20 +200,41 @@ class SetOptionsOpFrame(OperationFrame):
             if b.signer.weight == 0:
                 if idx is None:
                     return self._res(C.SET_OPTIONS_BAD_SIGNER)
+                old_sponsor = sids[idx].value if sids[idx] is not None \
+                    else None
+                # the sponsor is always a different account (begin-
+                # sponsoring's recursion rules forbid self-sponsorship)
+                SP.release_signer_sponsorship(ltx, old_sponsor)
+                if old_sponsor is not None:
+                    acc = SP.add_num_sponsored(acc, -1)
                 signers.pop(idx)
+                sids.pop(idx)
                 acc = acc._replace(numSubEntries=acc.numSubEntries - 1)
             elif idx is not None:
                 signers[idx] = b.signer
             else:
                 if len(signers) >= T.MAX_SIGNERS:
                     return self._res(C.SET_OPTIONS_TOO_MANY_SIGNERS)
-                acc2 = acc._replace(numSubEntries=acc.numSubEntries + 1)
-                if acc.balance < U.min_balance(header, acc2):
-                    return self._res(C.SET_OPTIONS_LOW_RESERVE)
-                acc = acc2
+                res, sponsor_id = SP.create_signer_with_possible_sponsorship(
+                    ltx, entry, self.source_account_id())
+                err = SP.map_sponsorship_result(
+                    res, self._res(C.SET_OPTIONS_LOW_RESERVE))
+                if err is not None:
+                    return err
+                acc = acc._replace(numSubEntries=acc.numSubEntries + 1)
+                if sponsor_id is not None:
+                    acc = SP.add_num_sponsored(acc, 1)
                 signers.append(b.signer)
-            signers.sort(key=lambda s: T.SignerKey.encode(s.key))
+                sids.append(T.account_id(sponsor_id)
+                            if sponsor_id is not None else None)
+            order = sorted(range(len(signers)),
+                           key=lambda i: T.SignerKey.encode(signers[i].key))
+            signers = [signers[i] for i in order]
+            sids = [sids[i] for i in order]
             acc = acc._replace(signers=signers)
+            if any(s is not None for s in sids) or (
+                    acc.ext.type == 1 and acc.ext.value.ext.type == 2):
+                acc = SP.set_signer_sponsoring_ids(acc, sids)
 
         _put_account(ltx, entry, acc)
         return self._res(C.SET_OPTIONS_SUCCESS)
@@ -218,72 +247,185 @@ class ChangeTrustOpFrame(OperationFrame):
     def _res(self, code):
         return op_inner(self.TYPE, T.ChangeTrustResult.make(code))
 
+    def _is_pool(self) -> bool:
+        return self.body.line.type == T.AssetType.ASSET_TYPE_POOL_SHARE
+
     def do_check_valid(self, header):
         C = T.ChangeTrustResultCode
+        from .. import liquidity_pool as LP
+
         line = self.body.line
-        if line.type == T.AssetType.ASSET_TYPE_POOL_SHARE:
-            return self._res(C.CHANGE_TRUST_MALFORMED)  # pools: not yet
+        if self.body.limit < 0:
+            return self._res(C.CHANGE_TRUST_MALFORMED)
         if line.type == T.AssetType.ASSET_TYPE_NATIVE:
             return self._res(C.CHANGE_TRUST_MALFORMED)
+        if line.type == T.AssetType.ASSET_TYPE_POOL_SHARE:
+            cp = line.value.value  # ConstantProduct params
+            for a in (cp.assetA, cp.assetB):
+                if not U.is_asset_valid(a):
+                    return self._res(C.CHANGE_TRUST_MALFORMED)
+                if U.asset_issuer(a) == self.source_account_id():
+                    return self._res(C.CHANGE_TRUST_SELF_NOT_ALLOWED)
+            if LP.compare_assets(cp.assetA, cp.assetB) >= 0:
+                return self._res(C.CHANGE_TRUST_MALFORMED)
+            if cp.fee != T.LIQUIDITY_POOL_FEE_V18:
+                return self._res(C.CHANGE_TRUST_MALFORMED)
+            return None
         asset = T.Asset.make(line.type, line.value)
         if not U.is_asset_valid(asset):
-            return self._res(C.CHANGE_TRUST_MALFORMED)
-        if self.body.limit < 0:
             return self._res(C.CHANGE_TRUST_MALFORMED)
         if U.asset_issuer(asset) == self.source_account_id():
             return self._res(C.CHANGE_TRUST_SELF_NOT_ALLOWED)
         return None
 
+    def _tl_asset(self):
+        from .. import liquidity_pool as LP
+
+        line = self.body.line
+        if self._is_pool():
+            pool_id = LP.pool_id_from_params(line.value)
+            return T.TrustLineAsset.make(
+                T.AssetType.ASSET_TYPE_POOL_SHARE, pool_id)
+        return T.TrustLineAsset.make(line.type, line.value)
+
+    def _load_tl(self, ltx, src_id):
+        arm = T.LedgerKey.arms[T.LedgerEntryType.TRUSTLINE][1].make(
+            accountID=T.account_id(src_id), asset=self._tl_asset())
+        return ltx.load(T.LedgerKey.make(T.LedgerEntryType.TRUSTLINE, arm))
+
+    def _inc_pool_use(self, ltx, asset, src_id):
+        """ref tryIncrementPoolUseCount: underlying-asset trustline must
+        exist + maintain-liabilities auth; bump its use count."""
+        from .. import liquidity_pool as LP
+        C = T.ChangeTrustResultCode
+
+        if U.is_native(asset) or U.asset_issuer(asset) == src_id:
+            return None
+        tl_entry = ltx.load_trustline(src_id, asset)
+        if tl_entry is None:
+            return self._res(C.CHANGE_TRUST_TRUST_LINE_MISSING)
+        tl = tl_entry.data.value
+        if not U.is_authorized_to_maintain_liabilities(tl):
+            return self._res(C.CHANGE_TRUST_NOT_AUTH_MAINTAIN_LIABILITIES)
+        _put_trustline(ltx, tl_entry, LP.tl_with_pool_use_delta(tl, 1))
+        return None
+
+    def _dec_pool_use(self, ltx, asset, src_id):
+        from .. import liquidity_pool as LP
+
+        if U.is_native(asset) or U.asset_issuer(asset) == src_id:
+            return
+        tl_entry = ltx.load_trustline(src_id, asset)
+        if tl_entry is not None:
+            _put_trustline(ltx, tl_entry,
+                           LP.tl_with_pool_use_delta(tl_entry.data.value, -1))
+
     def do_apply(self, ltx):
         C = T.ChangeTrustResultCode
-        header = ltx.header()
-        src_id = self.source_account_id()
-        asset = T.Asset.make(self.body.line.type, self.body.line.value)
-        limit = self.body.limit
-        acc_entry = self.load_source_account(ltx)
-        acc = acc_entry.data.value
-        tl_entry = ltx.load_trustline(src_id, asset)
+        from .. import liquidity_pool as LP
+        from .. import sponsorship as SP
 
-        if limit == 0:
-            if tl_entry is None:
-                return self._res(C.CHANGE_TRUST_TRUST_LINE_MISSING)
+        src_id = self.source_account_id()
+        line = self.body.line
+        limit = self.body.limit
+        is_pool = self._is_pool()
+        tl_entry = self._load_tl(ltx, src_id)
+
+        if tl_entry is not None:
             tl = tl_entry.data.value
-            if tl.balance != 0:
+            buying, _ = U.trustline_liabilities(tl)
+            if limit != 0 and limit < tl.balance + buying:
                 return self._res(C.CHANGE_TRUST_INVALID_LIMIT)
-            bl, sl = U.trustline_liabilities(tl)
-            if bl or sl:
-                return self._res(C.CHANGE_TRUST_CANNOT_DELETE)
-            ltx.erase(entry_to_key(tl_entry))
-            acc = acc._replace(numSubEntries=acc.numSubEntries - 1)
-            _put_account(ltx, acc_entry, acc)
+            if limit == 0:
+                if tl.balance != 0:
+                    return self._res(C.CHANGE_TRUST_INVALID_LIMIT)
+                bl, sl = U.trustline_liabilities(tl)
+                if bl or sl:
+                    return self._res(C.CHANGE_TRUST_CANNOT_DELETE)
+                if not is_pool and LP.tl_pool_use_count(tl) != 0:
+                    return self._res(C.CHANGE_TRUST_CANNOT_DELETE)
+                SP.remove_entry_with_possible_sponsorship(
+                    ltx, tl_entry, src_id)
+                ltx.erase(entry_to_key(tl_entry))
+                if is_pool:
+                    cp_params = line.value.value
+                    self._dec_pool_use(ltx, cp_params.assetA, src_id)
+                    self._dec_pool_use(ltx, cp_params.assetB, src_id)
+                    pool_id = LP.pool_id_from_params(line.value)
+                    pool_entry = LP.load_pool(ltx, pool_id)
+                    if pool_entry is None:
+                        raise RuntimeError("liquidity pool is missing")
+                    cp = LP.constant_product(pool_entry)
+                    cp = cp._replace(
+                        poolSharesTrustLineCount=cp
+                        .poolSharesTrustLineCount - 1)
+                    if cp.poolSharesTrustLineCount == 0:
+                        ltx.erase(entry_to_key(pool_entry))
+                    else:
+                        ltx.put(LP.pool_with_cp(pool_entry, cp))
+                return self._res(C.CHANGE_TRUST_SUCCESS)
+            if not is_pool and ltx.load_account(
+                    U.asset_issuer(T.Asset.make(line.type,
+                                                line.value))) is None:
+                return self._res(C.CHANGE_TRUST_NO_ISSUER)
+            _put_trustline(ltx, tl_entry,
+                           tl_entry.data.value._replace(limit=limit))
             return self._res(C.CHANGE_TRUST_SUCCESS)
 
-        issuer_id = U.asset_issuer(asset)
-        if tl_entry is None:
-            if ltx.load_account(issuer_id) is None:
+        # new trustline
+        if limit == 0:
+            return self._res(C.CHANGE_TRUST_INVALID_LIMIT)
+        flags = 0
+        if not is_pool:
+            asset = T.Asset.make(line.type, line.value)
+            issuer_entry = ltx.load_account(U.asset_issuer(asset))
+            if issuer_entry is None:
                 return self._res(C.CHANGE_TRUST_NO_ISSUER)
-            acc2 = acc._replace(numSubEntries=acc.numSubEntries + 1)
-            if acc.balance < U.min_balance(header, acc2):
-                return self._res(C.CHANGE_TRUST_LOW_RESERVE)
-            issuer_entry = ltx.load_account(issuer_id)
             issuer = issuer_entry.data.value
-            flags = 0
             if not issuer.flags & T.AUTH_REQUIRED_FLAG:
                 flags |= T.AUTHORIZED_FLAG
             if issuer.flags & T.AUTH_CLAWBACK_ENABLED_FLAG:
                 flags |= T.TRUSTLINE_CLAWBACK_ENABLED_FLAG
-            ltx.put(U.make_trustline_entry(
-                src_id, asset, balance=0, limit=limit, flags=flags))
-            _put_account(ltx, acc_entry, acc2)
         else:
-            tl = tl_entry.data.value
-            buying, _ = U.trustline_liabilities(tl)
-            if limit < tl.balance + buying:
-                return self._res(C.CHANGE_TRUST_INVALID_LIMIT)
-            if ltx.load_account(issuer_id) is None:
-                return self._res(C.CHANGE_TRUST_NO_ISSUER)
-            tl = tl._replace(limit=limit)
-            _put_trustline(ltx, tl_entry, tl)
+            cp_params = line.value.value
+            err = self._inc_pool_use(ltx, cp_params.assetA, src_id)
+            if err is not None:
+                return err
+            err = self._inc_pool_use(ltx, cp_params.assetB, src_id)
+            if err is not None:
+                return err
+            pool_id = LP.pool_id_from_params(line.value)
+            pool_entry = LP.load_pool(ltx, pool_id)
+            if pool_entry is not None:
+                cp = LP.constant_product(pool_entry)
+                cp = cp._replace(
+                    poolSharesTrustLineCount=cp.poolSharesTrustLineCount + 1)
+                ltx.put(LP.pool_with_cp(pool_entry, cp))
+            else:
+                cp = T.LiquidityPoolEntry.fields[1][1].arms[
+                    T.LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT][
+                    1].make(params=cp_params, reserveA=0, reserveB=0,
+                            totalPoolShares=0, poolSharesTrustLineCount=1)
+                lp = T.LiquidityPoolEntry.make(
+                    liquidityPoolID=pool_id,
+                    body=T.LiquidityPoolEntry.fields[1][1].make(
+                        T.LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT,
+                        cp))
+                ltx.put(U.wrap_entry(T.LedgerEntryType.LIQUIDITY_POOL, lp))
+
+        tl = T.TrustLineEntry.make(
+            accountID=T.account_id(src_id),
+            asset=self._tl_asset(),
+            balance=0, limit=limit, flags=flags,
+            ext=T.TrustLineEntry.fields[5][1].make(0))
+        new_entry = U.wrap_entry(T.LedgerEntryType.TRUSTLINE, tl)
+        res, new_entry = SP.create_entry_with_possible_sponsorship(
+            ltx, new_entry, src_id)
+        err = SP.map_sponsorship_result(
+            res, self._res(C.CHANGE_TRUST_LOW_RESERVE))
+        if err is not None:
+            return err
+        ltx.put(new_entry)
         return self._res(C.CHANGE_TRUST_SUCCESS)
 
 

@@ -29,20 +29,33 @@ class CreateAccountOpFrame(OperationFrame):
 
     def do_apply(self, ltx):
         C = T.CreateAccountResultCode
+        from .. import sponsorship as SP
+
         header = ltx.header()
         dest = self.body.destination.value
         if ltx.load_account(dest) is not None:
             return self._res(C.CREATE_ACCOUNT_ALREADY_EXIST)
-        # destination must be fundable to at least the base reserve
-        if self.body.startingBalance < 2 * header.baseReserve:
-            return self._res(C.CREATE_ACCOUNT_LOW_RESERVE)
+
+        new_entry = U.make_account_entry(dest, self.body.startingBalance)
+        # reserve: paid by the new balance itself, or by the active sponsor
+        # of the DESTINATION id (ref CreateAccountOpFrame::doApply ->
+        # createEntryWithPossibleSponsorship with sponsoredID = dest)
+        res, new_entry = SP.create_entry_with_possible_sponsorship(
+            ltx, new_entry, dest, owner_entry=new_entry)
+        err = SP.map_sponsorship_result(
+            res, self._res(C.CREATE_ACCOUNT_LOW_RESERVE))
+        if err is not None:
+            return err
+        # debit AFTER the sponsorship accounting: if the source is itself
+        # the sponsor, numSponsoring just raised its reserve floor (ref
+        # addBalance enforcing newBalance >= minBalance on debit)
         src_entry = self.load_source_account(ltx)
         src = src_entry.data.value
         if U.get_available_balance(header, src) < self.body.startingBalance:
             return self._res(C.CREATE_ACCOUNT_UNDERFUNDED)
-        src = U.add_balance(src, -self.body.startingBalance)
-        put_account(ltx, src_entry, src)
-        ltx.put(U.make_account_entry(dest, self.body.startingBalance))
+        put_account(ltx, src_entry,
+                    U.add_balance(src, -self.body.startingBalance))
+        ltx.put(new_entry)
         return self._res(C.CREATE_ACCOUNT_SUCCESS)
 
 
@@ -158,7 +171,9 @@ class AccountMergeOpFrame(OperationFrame):
         src = src_entry.data.value
         if src.flags & T.AUTH_IMMUTABLE_FLAG:
             return self._res_code(C.ACCOUNT_MERGE_IMMUTABLE_SET)
-        if src.numSubEntries != 0:
+        # signers are the one subentry type allowed at merge time (ref
+        # MergeOpFrame: numSubEntries != signers.size() -> HAS_SUB_ENTRIES)
+        if src.numSubEntries != len(src.signers):
             return self._res_code(C.ACCOUNT_MERGE_HAS_SUB_ENTRIES)
         if U.num_sponsoring(src) != 0:
             return self._res_code(C.ACCOUNT_MERGE_IS_SPONSOR)
@@ -174,7 +189,17 @@ class AccountMergeOpFrame(OperationFrame):
         dest = U.add_balance(dest, balance)
         put_account(ltx, dest_entry, dest)
         from ...ledger.ledger_txn import entry_to_key
+        from .. import sponsorship as SP
 
+        # release every sponsored signer's reserve (the account dies, so
+        # only the sponsors' numSponsoring needs correcting — ref
+        # MergeOpFrame removing signer sponsorships before the erase)
+        for sid in SP.signer_sponsoring_ids(src):
+            if sid is not None:
+                SP.release_signer_sponsorship(ltx, sid.value)
+        # release the account-entry sponsorship, if any (mult 2)
+        src_entry = ltx.load_account(src_id)
+        SP.remove_entry_with_possible_sponsorship(ltx, src_entry, None)
         ltx.erase(entry_to_key(src_entry))
         return op_inner(self.TYPE, T.AccountMergeResult.make(
             C.ACCOUNT_MERGE_SUCCESS, balance))

@@ -1,0 +1,110 @@
+"""libsodium edge-case vectors: small-order / non-canonical / malleable
+inputs must get the same verdict from the executable spec, the CPU backend
+(OpenSSL + blacklist prefilter), the XLA kernel, and the Pallas kernel
+(interpret mode) — pinning the whole framework to libsodium
+crypto_sign_verify_detached semantics (ref src/crypto/SecretKey.cpp:428-459;
+VERDICT r2 weak #4)."""
+import numpy as np
+import pytest
+
+from stellar_core_tpu.crypto import SecretKey, sha256
+from stellar_core_tpu.crypto import ed25519 as ed
+from stellar_core_tpu.crypto import ed25519_ref as ref
+
+
+def _valid_triple(i=0):
+    sk = SecretKey(sha256(b"edge%d" % i))
+    msg = sha256(b"edge-msg%d" % i)
+    return sk.public_key().raw, sk.sign(msg), msg
+
+
+def _vectors():
+    """(pubkey, sig, msg, label) edge inputs.  Expected verdicts come from
+    the spec; the point of the test is four-way agreement."""
+    out = []
+    pk, sig, msg = _valid_triple()
+    out.append((pk, sig, msg, "valid"))
+    out.append((pk, sig[:-1] + bytes([sig[-1] ^ 1]), msg, "bad-sig"))
+
+    # small-order A (all 10 blacklist encodings), structurally valid sig
+    for j, enc in enumerate(ref.SMALL_ORDER_ENCODINGS):
+        out.append((enc, sig, msg, f"small-order-A-{j}"))
+    # small-order R
+    for j, enc in enumerate(ref.SMALL_ORDER_ENCODINGS):
+        out.append((pk, enc + sig[32:], msg, f"small-order-R-{j}"))
+
+    # non-canonical A: y >= p (y = p + 1 -> encodes like (0,1)+p)
+    nc = int.to_bytes(ref.P + 1, 32, "little")
+    out.append((nc, sig, msg, "non-canonical-A"))
+    out.append((pk, nc + sig[32:], msg, "non-canonical-R"))
+
+    # s >= L (malleability): s' = s + L
+    s = int.from_bytes(sig[32:], "little")
+    s_mall = int.to_bytes(s + ref.L, 32, "little")
+    out.append((pk, sig[:32] + s_mall, msg, "malleable-s"))
+
+    # off-curve A (y with no valid x)
+    y = 2
+    while ref._recover_x(y, 0) is not None:
+        y += 1
+    out.append((int.to_bytes(y, 32, "little"), sig, msg, "off-curve-A"))
+    return out
+
+
+VECTORS = _vectors()
+
+
+def test_spec_verdicts():
+    """Sanity: the spec rejects every malformed vector and accepts the
+    valid one."""
+    for pk, sig, msg, label in VECTORS:
+        got = ref.verify(pk, sig, msg)
+        assert got == (label == "valid"), label
+
+
+def test_cpu_backend_matches_spec():
+    for pk, sig, msg, label in VECTORS:
+        assert ed.raw_verify(pk, sig, msg) == ref.verify(pk, sig, msg), label
+
+
+def test_xla_kernel_matches_spec():
+    from stellar_core_tpu.ops.ed25519_kernel import verify_batch
+
+    n = len(VECTORS)
+    pk = np.frombuffer(b"".join(v[0] for v in VECTORS),
+                       np.uint8).reshape(n, 32)
+    sg = np.frombuffer(b"".join(v[1] for v in VECTORS),
+                       np.uint8).reshape(n, 64)
+    mg = np.frombuffer(b"".join(v[2] for v in VECTORS),
+                       np.uint8).reshape(n, 32)
+    got = np.asarray(verify_batch(pk, sg, mg))
+    for (pkb, sig, msg, label), g in zip(VECTORS, got):
+        assert bool(g) == ref.verify(pkb, sig, msg), label
+
+
+@pytest.mark.slow
+def test_pallas_kernel_matches_spec_interpret():
+    from stellar_core_tpu.ops.ed25519_pallas import verify_batch
+
+    n = len(VECTORS)
+    pk = np.frombuffer(b"".join(v[0] for v in VECTORS),
+                       np.uint8).reshape(n, 32)
+    sg = np.frombuffer(b"".join(v[1] for v in VECTORS),
+                       np.uint8).reshape(n, 64)
+    mg = np.frombuffer(b"".join(v[2] for v in VECTORS),
+                       np.uint8).reshape(n, 32)
+    got = np.asarray(verify_batch(pk, sg, mg, interpret=True))
+    for (pkb, sig, msg, label), g in zip(VECTORS, got):
+        assert bool(g) == ref.verify(pkb, sig, msg), label
+
+
+def test_torsion_subgroup_structure():
+    """The generated blacklist covers the full 8-torsion subgroup."""
+    pts = ref._torsion_points()
+    assert len(pts) == 8
+    for pt in pts:
+        assert ref._is_identity(ref.scalar_mult(8, ref.to_extended(pt)))
+    # contains identity and (0,-1)
+    assert (0, 1) in pts and (0, ref.P - 1) in pts
+    # 10 encodings: 8 canonical + 2 extra -0 sign variants
+    assert len(ref.SMALL_ORDER_ENCODINGS) == 10

@@ -1,0 +1,473 @@
+"""Claimable balances, sponsorship, liquidity pools, fee-bump — the 8 ops
+added in round 3 plus FeeBumpTransactionFrame (ref test models:
+src/transactions/test/{ClaimableBalanceTests,RevokeSponsorshipTests,
+LiquidityPoolDepositTests,FeeBumpTransactionTests}.cpp)."""
+import pytest
+
+from stellar_core_tpu.ledger import LedgerTxn
+from stellar_core_tpu.transactions import liquidity_pool as LP
+from stellar_core_tpu.transactions import sponsorship as SP
+from stellar_core_tpu.transactions import utils as U
+from stellar_core_tpu.xdr import types as T
+
+from .txtest import BASE_RESERVE, TestLedger
+
+TC = T.TransactionResultCode
+
+
+@pytest.fixture
+def ledger():
+    return TestLedger()
+
+
+def op_code(result, i=0):
+    """Per-op inner result code of op i."""
+    return result.result.value[i].value.value.type
+
+
+def cb_key(balance_id):
+    return T.LedgerKey.make(
+        T.LedgerEntryType.CLAIMABLE_BALANCE,
+        T.LedgerKey.arms[T.LedgerEntryType.CLAIMABLE_BALANCE][1].make(
+            balanceID=balance_id))
+
+
+# ---------------------------------------------------------------------------
+# claimable balances
+# ---------------------------------------------------------------------------
+
+class TestClaimableBalance:
+    def test_create_claim_native(self, ledger):
+        root = ledger.root()
+        a = root.create("alice", 10**9)
+        b = root.create("bob", 10**9)
+        ok, res = a.apply(a.tx([a.op_create_claimable_balance(
+            U.asset_native(), 10**8, [(b.account_id, None)])]))
+        bid = res.result.value[0].value.value.value  # balanceID
+        # creator sponsors the entry reserve
+        acc = a.account_entry().data.value
+        assert U.num_sponsoring(acc) == 1
+        entry = a.entry(cb_key(bid))
+        assert entry is not None
+        assert SP.entry_sponsor(entry) == a.account_id
+
+        before = b.balance()
+        b.apply(b.tx([b.op_claim_claimable_balance(bid)]))
+        assert b.balance() == before + 10**8 - 100  # minus fee
+        assert a.entry(cb_key(bid)) is None
+        assert U.num_sponsoring(a.account_entry().data.value) == 0
+
+    def test_claim_wrong_account(self, ledger):
+        root = ledger.root()
+        a = root.create("alice", 10**9)
+        b = root.create("bob", 10**9)
+        c = root.create("carol", 10**9)
+        ok, res = a.apply(a.tx([a.op_create_claimable_balance(
+            U.asset_native(), 10**8, [(b.account_id, None)])]))
+        bid = res.result.value[0].value.value.value
+        ok, res = c.apply(c.tx([c.op_claim_claimable_balance(bid)]),
+                          expect_success=False)
+        assert not ok
+        C = T.ClaimClaimableBalanceResultCode
+        assert op_code(res) == C.CLAIM_CLAIMABLE_BALANCE_CANNOT_CLAIM
+
+    def test_predicate_absolute_time(self, ledger):
+        root = ledger.root()
+        a = root.create("alice", 10**9)
+        b = root.create("bob", 10**9)
+        # expires before the ledger close time (1000): not claimable
+        pred = T.ClaimPredicate.make(
+            T.ClaimPredicateType.CLAIM_PREDICATE_BEFORE_ABSOLUTE_TIME, 500)
+        ok, res = a.apply(a.tx([a.op_create_claimable_balance(
+            U.asset_native(), 10**8, [(b.account_id, pred)])]))
+        bid = res.result.value[0].value.value.value
+        ok, res = b.apply(b.tx([b.op_claim_claimable_balance(bid)]),
+                          expect_success=False)
+        C = T.ClaimClaimableBalanceResultCode
+        assert op_code(res) == C.CLAIM_CLAIMABLE_BALANCE_CANNOT_CLAIM
+
+    def test_predicate_relative_becomes_absolute(self, ledger):
+        root = ledger.root()
+        a = root.create("alice", 10**9)
+        b = root.create("bob", 10**9)
+        pred = T.ClaimPredicate.make(
+            T.ClaimPredicateType.CLAIM_PREDICATE_BEFORE_RELATIVE_TIME, 600)
+        ok, res = a.apply(a.tx([a.op_create_claimable_balance(
+            U.asset_native(), 10**8, [(b.account_id, pred)])]))
+        bid = res.result.value[0].value.value.value
+        entry = a.entry(cb_key(bid))
+        stored = entry.data.value.claimants[0].value.predicate
+        PT = T.ClaimPredicateType
+        assert stored.type == PT.CLAIM_PREDICATE_BEFORE_ABSOLUTE_TIME
+        assert stored.value == 1000 + 600  # close_time + rel
+        b.apply(b.tx([b.op_claim_claimable_balance(bid)]))
+
+    def test_create_credit_and_clawback(self, ledger):
+        root = ledger.root()
+        issuer = root.create("issuer", 10**9)
+        a = root.create("alice", 10**9)
+        b = root.create("bob", 10**9)
+        # enable clawback on the issuer account
+        issuer.apply(issuer.tx([issuer.op_set_options(
+            set_flags=T.AUTH_CLAWBACK_ENABLED_FLAG
+            | T.AUTH_REVOCABLE_FLAG)]))
+        usd = U.make_asset(b"USD", issuer.account_id)
+        a.apply(a.tx([a.op_change_trust(usd)]))
+        b.apply(b.tx([b.op_change_trust(usd)]))
+        issuer.apply(issuer.tx([issuer.op_payment(a.account_id, 10**7,
+                                                  usd)]))
+        ok, res = a.apply(a.tx([a.op_create_claimable_balance(
+            usd, 10**6, [(b.account_id, None)])]))
+        bid = res.result.value[0].value.value.value
+        entry = a.entry(cb_key(bid))
+        cb = entry.data.value
+        assert cb.ext.type == 1  # clawback-enabled ext
+        ok, res = issuer.apply(issuer.tx(
+            [issuer.op_clawback_claimable_balance(bid)]))
+        assert a.entry(cb_key(bid)) is None
+
+    def test_create_requires_trust_and_funds(self, ledger):
+        root = ledger.root()
+        issuer = root.create("issuer", 10**9)
+        a = root.create("alice", 10**9)
+        b = root.create("bob", 10**9)
+        usd = U.make_asset(b"USD", issuer.account_id)
+        C = T.CreateClaimableBalanceResultCode
+        ok, res = a.apply(a.tx([a.op_create_claimable_balance(
+            usd, 10**6, [(b.account_id, None)])]), expect_success=False)
+        assert op_code(res) == C.CREATE_CLAIMABLE_BALANCE_NO_TRUST
+        a.apply(a.tx([a.op_change_trust(usd)]))
+        ok, res = a.apply(a.tx([a.op_create_claimable_balance(
+            usd, 10**6, [(b.account_id, None)])]), expect_success=False)
+        assert op_code(res) == C.CREATE_CLAIMABLE_BALANCE_UNDERFUNDED
+
+    def test_malformed(self, ledger):
+        root = ledger.root()
+        a = root.create("alice", 10**9)
+        C = T.CreateClaimableBalanceResultCode
+        # duplicate claimants
+        ok, res = a.apply(a.tx([a.op_create_claimable_balance(
+            U.asset_native(), 10**6,
+            [(a.account_id, None), (a.account_id, None)])]),
+            expect_success=False)
+        assert op_code(res) == C.CREATE_CLAIMABLE_BALANCE_MALFORMED
+
+
+# ---------------------------------------------------------------------------
+# sponsorship
+# ---------------------------------------------------------------------------
+
+def account_key(account_id):
+    return T.LedgerKey.make(
+        T.LedgerEntryType.ACCOUNT,
+        T.LedgerKey.arms[T.LedgerEntryType.ACCOUNT][1].make(
+            accountID=T.account_id(account_id)))
+
+
+def trustline_key(account_id, asset):
+    return T.LedgerKey.make(
+        T.LedgerEntryType.TRUSTLINE,
+        T.LedgerKey.arms[T.LedgerEntryType.TRUSTLINE][1].make(
+            accountID=T.account_id(account_id),
+            asset=U.to_trustline_asset(asset)))
+
+
+class TestSponsorship:
+    def _sponsored_create(self, ledger, balance=0):
+        """Sponsor (A) pays the reserve for a brand-new account (C)."""
+        root = ledger.root()
+        a = root.create("sponsor", 10**9)
+        from stellar_core_tpu.crypto import SecretKey, sha256
+        from .txtest import TestAccount
+
+        c = TestAccount(ledger, SecretKey(sha256(b"newacct")))
+        env = a.tx([
+            a.op_begin_sponsoring(c.account_id),
+            a.op_create_account(c.account_id, balance),
+            a.op_end_sponsoring(source=c.account_id),
+        ], extra_signers=[c.secret])
+        a.apply(env)
+        return root, a, c
+
+    def test_sponsored_account_creation_zero_balance(self, ledger):
+        root, a, c = self._sponsored_create(ledger, balance=0)
+        assert c.exists()
+        acc = c.account_entry()
+        assert U.num_sponsored(acc.data.value) == 2
+        assert SP.entry_sponsor(acc) == a.account_id
+        assert U.num_sponsoring(a.account_entry().data.value) == 2
+
+    def test_unclosed_sponsorship_fails_tx(self, ledger):
+        root = ledger.root()
+        a = root.create("sponsor", 10**9)
+        b = root.create("other", 10**9)
+        env = a.tx([a.op_begin_sponsoring(b.account_id)])
+        ok, res = a.apply(env, expect_success=False)
+        assert not ok
+        assert res.result.type == TC.txBAD_SPONSORSHIP
+
+    def test_end_without_begin(self, ledger):
+        root = ledger.root()
+        a = root.create("acc", 10**9)
+        ok, res = a.apply(a.tx([a.op_end_sponsoring()]),
+                          expect_success=False)
+        C = T.EndSponsoringFutureReservesResultCode
+        assert op_code(res) == C.END_SPONSORING_FUTURE_RESERVES_NOT_SPONSORED
+
+    def test_sponsored_trustline_and_revoke_remove(self, ledger):
+        root = ledger.root()
+        sponsor = root.create("sponsor", 10**9)
+        issuer = root.create("issuer", 10**9)
+        a = root.create("alice", 10**9)
+        usd = U.make_asset(b"USD", issuer.account_id)
+        env = sponsor.tx([
+            sponsor.op_begin_sponsoring(a.account_id),
+            a.op_change_trust(usd, source=None) if False else
+            sponsor.op(T.OperationType.CHANGE_TRUST, T.ChangeTrustOp.make(
+                line=T.ChangeTrustAsset.make(usd.type, usd.value),
+                limit=U.INT64_MAX), source=a.account_id),
+            sponsor.op_end_sponsoring(source=a.account_id),
+        ], extra_signers=[a.secret])
+        sponsor.apply(env)
+        tl = a.entry(trustline_key(a.account_id, usd))
+        assert SP.entry_sponsor(tl) == sponsor.account_id
+        assert U.num_sponsoring(sponsor.account_entry().data.value) == 1
+        assert U.num_sponsored(a.account_entry().data.value) == 1
+
+        # sponsor revokes (removes) the sponsorship: alice takes the reserve
+        ok, res = sponsor.apply(sponsor.tx([
+            sponsor.op_revoke_sponsorship_key(
+                trustline_key(a.account_id, usd))]))
+        tl = a.entry(trustline_key(a.account_id, usd))
+        assert SP.entry_sponsor(tl) is None
+        assert U.num_sponsoring(sponsor.account_entry().data.value) == 0
+        assert U.num_sponsored(a.account_entry().data.value) == 0
+
+    def test_revoke_not_sponsor(self, ledger):
+        root = ledger.root()
+        issuer = root.create("issuer", 10**9)
+        a = root.create("alice", 10**9)
+        b = root.create("mallory", 10**9)
+        usd = U.make_asset(b"USD", issuer.account_id)
+        a.apply(a.tx([a.op_change_trust(usd)]))
+        ok, res = b.apply(b.tx([b.op_revoke_sponsorship_key(
+            trustline_key(a.account_id, usd))]), expect_success=False)
+        C = T.RevokeSponsorshipResultCode
+        assert op_code(res) == C.REVOKE_SPONSORSHIP_NOT_SPONSOR
+
+    def test_sponsored_signer(self, ledger):
+        root = ledger.root()
+        sponsor = root.create("sponsor", 10**9)
+        a = root.create("alice", 10**9)
+        skey = T.SignerKey.make(T.SignerKeyType.SIGNER_KEY_TYPE_ED25519,
+                                b"\x42" * 32)
+        env = sponsor.tx([
+            sponsor.op_begin_sponsoring(a.account_id),
+            sponsor.op(T.OperationType.SET_OPTIONS, T.SetOptionsOp.make(
+                inflationDest=None, clearFlags=None, setFlags=None,
+                masterWeight=None, lowThreshold=None, medThreshold=None,
+                highThreshold=None, homeDomain=None,
+                signer=T.Signer.make(key=skey, weight=1)),
+                source=a.account_id),
+            sponsor.op_end_sponsoring(source=a.account_id),
+        ], extra_signers=[a.secret])
+        sponsor.apply(env)
+        acc = a.account_entry().data.value
+        assert U.num_sponsored(acc) == 1
+        assert U.num_sponsoring(sponsor.account_entry().data.value) == 1
+        sids = SP.signer_sponsoring_ids(acc)
+        assert len(sids) == 1 and sids[0].value == sponsor.account_id
+
+        # removing the signer releases the sponsor's reserve
+        a.apply(a.tx([a.op_set_options(
+            signer=T.Signer.make(key=skey, weight=0))]))
+        assert U.num_sponsoring(sponsor.account_entry().data.value) == 0
+        assert U.num_sponsored(a.account_entry().data.value) == 0
+
+    def test_begin_recursive_rejected(self, ledger):
+        root = ledger.root()
+        a = root.create("aa", 10**9)
+        b = root.create("bb", 10**9)
+        c = root.create("cc", 10**9)
+        # a sponsors b; while active, b tries to sponsor c => RECURSIVE
+        env = a.tx([
+            a.op_begin_sponsoring(b.account_id),
+            a.op_begin_sponsoring(c.account_id, source=b.account_id),
+            a.op_end_sponsoring(source=b.account_id),
+        ], extra_signers=[b.secret])
+        ok, res = a.apply(env, expect_success=False)
+        C = T.BeginSponsoringFutureReservesResultCode
+        assert op_code(res, 1) == \
+            C.BEGIN_SPONSORING_FUTURE_RESERVES_RECURSIVE
+
+
+# ---------------------------------------------------------------------------
+# liquidity pools
+# ---------------------------------------------------------------------------
+
+class TestLiquidityPool:
+    def _setup_pool(self, ledger):
+        root = ledger.root()
+        issuer = root.create("issuer", 10**10)
+        a = root.create("alice", 10**10)
+        usd = U.make_asset(b"USD", issuer.account_id)
+        a.apply(a.tx([a.op_change_trust(usd)]))
+        issuer.apply(issuer.tx([issuer.op_payment(a.account_id, 10**9,
+                                                  usd)]))
+        xlm = U.asset_native()
+        params = T.LiquidityPoolParameters.make(
+            T.LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT,
+            T.LiquidityPoolConstantProductParameters.make(
+                assetA=xlm, assetB=usd, fee=T.LIQUIDITY_POOL_FEE_V18))
+        pool_id = LP.pool_id_from_params(params)
+        a.apply(a.tx([a.op_change_trust_pool(xlm, usd)]))
+        return root, issuer, a, usd, pool_id
+
+    def test_pool_trustline_creates_pool(self, ledger):
+        root, issuer, a, usd, pool_id = self._setup_pool(ledger)
+        pool = a.entry(LP.pool_key(pool_id))
+        assert pool is not None
+        cp = LP.constant_product(pool)
+        assert cp.poolSharesTrustLineCount == 1
+        assert cp.reserveA == 0 and cp.reserveB == 0
+        # pool-share trustline costs 2 subentries
+        assert a.account_entry().data.value.numSubEntries == 3  # usd + 2
+        # underlying USD trustline got a use count
+        tl = a.entry(trustline_key(a.account_id, usd))
+        assert LP.tl_pool_use_count(tl.data.value) == 1
+
+    def test_deposit_withdraw_round_trip(self, ledger):
+        root, issuer, a, usd, pool_id = self._setup_pool(ledger)
+        a.apply(a.tx([a.op_pool_deposit(pool_id, 4 * 10**6, 10**6)]))
+        pool = a.entry(LP.pool_key(pool_id))
+        cp = LP.constant_product(pool)
+        assert cp.reserveA == 4 * 10**6 and cp.reserveB == 10**6
+        assert cp.totalPoolShares == 2 * 10**6  # sqrt(4e6 * 1e6)
+        tl_pool = a.entry(LP.pool_share_trustline_key(a.account_id,
+                                                      pool_id))
+        assert tl_pool.data.value.balance == 2 * 10**6
+
+        # second deposit follows the existing ratio
+        a.apply(a.tx([a.op_pool_deposit(pool_id, 4 * 10**6, 10**6)]))
+        cp = LP.constant_product(a.entry(LP.pool_key(pool_id)))
+        assert cp.reserveA == 8 * 10**6 and cp.reserveB == 2 * 10**6
+        assert cp.totalPoolShares == 4 * 10**6
+
+        # withdraw half
+        a.apply(a.tx([a.op_pool_withdraw(pool_id, 2 * 10**6)]))
+        cp = LP.constant_product(a.entry(LP.pool_key(pool_id)))
+        assert cp.reserveA == 4 * 10**6 and cp.reserveB == 10**6
+        assert cp.totalPoolShares == 2 * 10**6
+
+    def test_deposit_bad_price(self, ledger):
+        root, issuer, a, usd, pool_id = self._setup_pool(ledger)
+        C = T.LiquidityPoolDepositResultCode
+        ok, res = a.apply(a.tx([a.op_pool_deposit(
+            pool_id, 4 * 10**6, 10**6,
+            min_price=(5, 1), max_price=(6, 1))]), expect_success=False)
+        assert op_code(res) == C.LIQUIDITY_POOL_DEPOSIT_BAD_PRICE
+
+    def test_delete_pool_trustline_deletes_pool(self, ledger):
+        root, issuer, a, usd, pool_id = self._setup_pool(ledger)
+        xlm = U.asset_native()
+        a.apply(a.tx([a.op_change_trust_pool(xlm, usd, limit=0)]))
+        assert a.entry(LP.pool_key(pool_id)) is None
+        tl = a.entry(trustline_key(a.account_id, usd))
+        assert LP.tl_pool_use_count(tl.data.value) == 0
+        assert a.account_entry().data.value.numSubEntries == 1
+
+    def test_cannot_delete_used_trustline(self, ledger):
+        root, issuer, a, usd, pool_id = self._setup_pool(ledger)
+        C = T.ChangeTrustResultCode
+        # zero the USD balance so the only deletion blocker is the pool's
+        # liquidityPoolUseCount
+        a.apply(a.tx([a.op_payment(issuer.account_id, 10**9, usd)]))
+        ok, res = a.apply(a.tx([a.op_change_trust(usd, limit=0)]),
+                          expect_success=False)
+        assert op_code(res) == C.CHANGE_TRUST_CANNOT_DELETE
+
+    def test_swap_math_invariants(self):
+        # constant-product: k never decreases across a swap
+        for (ra, rb, amt) in [(10**7, 10**7, 10**5), (10**9, 10**5, 10**4),
+                              (3, 10**12, 1)]:
+            out = LP.swap_out_given_in(ra, rb, amt, 30)
+            if out is not None:
+                assert (ra + amt) * (rb - out) >= ra * rb
+            back = LP.swap_in_given_out(ra, rb, 10**3, 30)
+            if back is not None:
+                assert (ra + back) * (rb - 10**3) >= ra * rb
+
+
+# ---------------------------------------------------------------------------
+# fee bump
+# ---------------------------------------------------------------------------
+
+class TestFeeBump:
+    def test_fee_bump_applies_inner(self, ledger):
+        root = ledger.root()
+        a = root.create("alice", 10**9)
+        b = root.create("bob", 10**9)
+        payer = root.create("payer", 10**9)
+        inner = a.tx([a.op_payment(b.account_id, 10**6)])
+        env = payer.fee_bump(inner, fee_source=payer)
+
+        a_before, b_before, p_before = a.balance(), b.balance(), \
+            payer.balance()
+        ok, res = payer.apply(env)
+        assert res.result.type == TC.txFEE_BUMP_INNER_SUCCESS
+        assert b.balance() == b_before + 10**6
+        assert a.balance() == a_before - 10**6  # no fee charged to inner
+        assert payer.balance() < p_before  # payer paid the fee
+        # inner result pair carries the inner hash
+        pair = res.result.value
+        from stellar_core_tpu.transactions.fee_bump import \
+            FeeBumpTransactionFrame
+        from .txtest import NETWORK_ID
+
+        frame = FeeBumpTransactionFrame(NETWORK_ID, env)
+        assert pair.transactionHash == frame.inner_hash()
+
+    def test_fee_bump_inner_failure_wrapped(self, ledger):
+        root = ledger.root()
+        a = root.create("alice", 10**9)
+        b = root.create("bob", 10**9)
+        payer = root.create("payer", 10**9)
+        inner = a.tx([a.op_payment(b.account_id, 10**15)])  # underfunded
+        env = payer.fee_bump(inner, fee_source=payer)
+        ok, res = payer.apply(env, expect_success=False)
+        assert not ok
+        assert res.result.type == TC.txFEE_BUMP_INNER_FAILED
+
+    def test_fee_bump_check_valid_fee_rules(self, ledger):
+        root = ledger.root()
+        a = root.create("alice", 10**9)
+        b = root.create("bob", 10**9)
+        payer = root.create("payer", 10**9)
+        from stellar_core_tpu.transactions.fee_bump import \
+            FeeBumpTransactionFrame
+        from .txtest import NETWORK_ID
+
+        inner = a.tx([a.op_payment(b.account_id, 10**6)], fee=200)
+        # outer fee below min fee for 2 "ops": rejected
+        env = payer.fee_bump(inner, fee=150, fee_source=payer)
+        frame = FeeBumpTransactionFrame(NETWORK_ID, env)
+        with LedgerTxn(ledger.root_txn) as ltx:
+            res = frame.check_valid(ltx)
+            ltx.rollback()
+        assert res.code == TC.txINSUFFICIENT_FEE
+
+        # outer fee rate below inner fee rate: rejected
+        env = payer.fee_bump(inner, fee=250, fee_source=payer)
+        frame = FeeBumpTransactionFrame(NETWORK_ID, env)
+        with LedgerTxn(ledger.root_txn) as ltx:
+            res = frame.check_valid(ltx)
+            ltx.rollback()
+        assert res.code == TC.txINSUFFICIENT_FEE
+
+        # healthy fee-bump validates
+        env = payer.fee_bump(inner, fee=500, fee_source=payer)
+        frame = FeeBumpTransactionFrame(NETWORK_ID, env)
+        with LedgerTxn(ledger.root_txn) as ltx:
+            res = frame.check_valid(ltx)
+            ltx.rollback()
+        assert res.ok

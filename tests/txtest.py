@@ -140,6 +140,110 @@ class TestAccount:
         return self.op(T.OperationType.ACCOUNT_MERGE,
                        T.muxed_account(dest))
 
+    def op_create_claimable_balance(self, asset, amount, claimants):
+        """claimants: list of (dest_account_id, ClaimPredicate|None)."""
+        cls = []
+        for dest, pred in claimants:
+            if pred is None:
+                pred = T.ClaimPredicate.make(
+                    T.ClaimPredicateType.CLAIM_PREDICATE_UNCONDITIONAL)
+            cls.append(T.Claimant.make(
+                T.ClaimantType.CLAIMANT_TYPE_V0,
+                T.Claimant.arms[T.ClaimantType.CLAIMANT_TYPE_V0][1].make(
+                    destination=T.account_id(dest), predicate=pred)))
+        return self.op(T.OperationType.CREATE_CLAIMABLE_BALANCE,
+                       T.CreateClaimableBalanceOp.make(
+                           asset=asset, amount=amount, claimants=cls))
+
+    def op_claim_claimable_balance(self, balance_id):
+        return self.op(T.OperationType.CLAIM_CLAIMABLE_BALANCE,
+                       T.ClaimClaimableBalanceOp.make(balanceID=balance_id))
+
+    def op_clawback_claimable_balance(self, balance_id):
+        return self.op(T.OperationType.CLAWBACK_CLAIMABLE_BALANCE,
+                       T.ClawbackClaimableBalanceOp.make(
+                           balanceID=balance_id))
+
+    def op_begin_sponsoring(self, sponsored_id: bytes, source=None):
+        return self.op(T.OperationType.BEGIN_SPONSORING_FUTURE_RESERVES,
+                       T.BeginSponsoringFutureReservesOp.make(
+                           sponsoredID=T.account_id(sponsored_id)),
+                       source=source)
+
+    def op_end_sponsoring(self, source=None):
+        return self.op(T.OperationType.END_SPONSORING_FUTURE_RESERVES,
+                       None, source=source)
+
+    def op_revoke_sponsorship_key(self, ledger_key, source=None):
+        return self.op(
+            T.OperationType.REVOKE_SPONSORSHIP,
+            T.RevokeSponsorshipOp.make(
+                T.RevokeSponsorshipType.REVOKE_SPONSORSHIP_LEDGER_ENTRY,
+                ledger_key), source=source)
+
+    def op_revoke_sponsorship_signer(self, account_id, signer_key,
+                                     source=None):
+        arm = T.RevokeSponsorshipOp.arms[
+            T.RevokeSponsorshipType.REVOKE_SPONSORSHIP_SIGNER][1]
+        return self.op(
+            T.OperationType.REVOKE_SPONSORSHIP,
+            T.RevokeSponsorshipOp.make(
+                T.RevokeSponsorshipType.REVOKE_SPONSORSHIP_SIGNER,
+                arm.make(accountID=T.account_id(account_id),
+                         signerKey=signer_key)), source=source)
+
+    def op_change_trust_pool(self, asset_a, asset_b, limit=U.INT64_MAX):
+        params = T.LiquidityPoolParameters.make(
+            T.LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT,
+            T.LiquidityPoolConstantProductParameters.make(
+                assetA=asset_a, assetB=asset_b,
+                fee=T.LIQUIDITY_POOL_FEE_V18))
+        return self.op(T.OperationType.CHANGE_TRUST, T.ChangeTrustOp.make(
+            line=T.ChangeTrustAsset.make(
+                T.AssetType.ASSET_TYPE_POOL_SHARE, params),
+            limit=limit))
+
+    def op_pool_deposit(self, pool_id, max_a, max_b,
+                        min_price=(1, 10**7), max_price=(10**7, 1)):
+        return self.op(T.OperationType.LIQUIDITY_POOL_DEPOSIT,
+                       T.LiquidityPoolDepositOp.make(
+                           liquidityPoolID=pool_id,
+                           maxAmountA=max_a, maxAmountB=max_b,
+                           minPrice=T.Price.make(n=min_price[0],
+                                                 d=min_price[1]),
+                           maxPrice=T.Price.make(n=max_price[0],
+                                                 d=max_price[1])))
+
+    def op_pool_withdraw(self, pool_id, amount, min_a=0, min_b=0):
+        return self.op(T.OperationType.LIQUIDITY_POOL_WITHDRAW,
+                       T.LiquidityPoolWithdrawOp.make(
+                           liquidityPoolID=pool_id, amount=amount,
+                           minAmountA=min_a, minAmountB=min_b))
+
+    def fee_bump(self, inner_env, fee: Optional[int] = None,
+                 fee_source: Optional["TestAccount"] = None):
+        """Wrap a v1 envelope in a fee-bump signed by fee_source (default:
+        self)."""
+        src = fee_source or self
+        inner_ops = len(inner_env.value.tx.operations)
+        fb = T.FeeBumpTransaction.make(
+            feeSource=T.muxed_account(src.account_id),
+            fee=fee if fee is not None else BASE_FEE * (inner_ops + 1) * 2,
+            innerTx=T.FeeBumpTransaction.fields[2][1].make(
+                T.EnvelopeType.ENVELOPE_TYPE_TX, inner_env.value),
+            ext=T.FeeBumpTransaction.fields[3][1].make(0))
+        payload = T.TransactionSignaturePayload.make(
+            networkId=src.network_id(),
+            taggedTransaction=T.TransactionSignaturePayload.fields[1][1]
+            .make(T.EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP, fb))
+        h = sha256(T.TransactionSignaturePayload.encode(payload))
+        sig = T.DecoratedSignature.make(
+            hint=signature_hint(src.secret.public_key().raw),
+            signature=src.secret.sign(h))
+        return T.TransactionEnvelope.make(
+            T.EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP,
+            T.FeeBumpTransactionEnvelope.make(tx=fb, signatures=[sig]))
+
     # -- tx builder ---------------------------------------------------------
 
     def tx(self, ops: List, fee: Optional[int] = None,
@@ -173,10 +277,11 @@ class TestAccount:
 
     def apply(self, env, expect_success=True):
         """processFeeSeqNum + apply against the root, like one-tx ledger
-        close; returns (ok, result)."""
-        from stellar_core_tpu.transactions import TransactionFrame
+        close; returns (ok, result).  Handles fee-bump envelopes too."""
+        from stellar_core_tpu.transactions.frame import \
+            tx_frame_from_envelope
 
-        frame = TransactionFrame(NETWORK_ID, env)
+        frame = tx_frame_from_envelope(NETWORK_ID, env)
         with LedgerTxn(self.ledger.root_txn) as ltx:
             frame.process_fee_seq_num(ltx, base_fee=BASE_FEE)
             ok, result, meta = frame.apply(ltx)
@@ -184,6 +289,18 @@ class TestAccount:
         if expect_success:
             assert ok, result
         return ok, result
+
+    def entry(self, key):
+        with LedgerTxn(self.ledger.root_txn) as ltx:
+            e = ltx.load(key)
+            ltx.rollback()
+        return e
+
+    def account_entry(self):
+        with LedgerTxn(self.ledger.root_txn) as ltx:
+            e = ltx.load_account(self.account_id)
+            ltx.rollback()
+        return e
 
     def check_valid(self, env):
         from stellar_core_tpu.transactions import TransactionFrame
