@@ -93,6 +93,29 @@ class Bucket:
                 out.append((kb, T.BucketEntry.make(BET.INITENTRY, entry)))
         return cls(out)
 
+    def serialize(self) -> bytes:
+        """Canonical XDR stream of BucketEntry (the on-disk/archive file
+        format, ref BucketOutputIterator)."""
+        return b"".join(T.BucketEntry.encode(e) for _, e in self.entries)
+
+    @classmethod
+    def deserialize(cls, data: bytes) -> "Bucket":
+        """Parse an XDR BucketEntry stream back into a Bucket (ref
+        BucketInputIterator); keys recomputed from the entries."""
+        from ..ledger.ledger_txn import entry_to_key, key_bytes
+        from ..xdr.runtime import Reader
+
+        out: List[Tuple[bytes, object]] = []
+        r = Reader(data)
+        while not r.done():
+            e = T.BucketEntry.unpack(r)
+            if e.type == BET.DEADENTRY:
+                kb = T.LedgerKey.encode(e.value)
+            else:
+                kb = key_bytes(entry_to_key(e.value))
+            out.append((kb, e))
+        return cls(out)
+
     @classmethod
     def merge(cls, newer: "Bucket", older: "Bucket") -> "Bucket":
         """Two-way sorted merge, newer shadowing older by key; INIT over
@@ -209,6 +232,44 @@ class BucketList:
                         out[kb] = e.value
         return out
 
+    # -- persistence / restore ---------------------------------------------
+
+    def level_hashes(self) -> List[Tuple[str, str]]:
+        """[(curr_hex, snap_hex)] per level — the HAS bucket list."""
+        return [(lv.curr.hash().hex(), lv.snap.hash().hex())
+                for lv in self.levels]
+
+    @classmethod
+    def restore(cls, level_hashes: Sequence[Tuple[str, str]],
+                loader) -> "BucketList":
+        """Rebuild from level hashes + a loader(hash_hex) -> bytes of the
+        serialized bucket (ref AssumeStateWork restoring the bucket list
+        from a HAS)."""
+        bl = cls()
+        cache: Dict[str, Bucket] = {}
+
+        def load(hh: str) -> Bucket:
+            if hh == "00" * 32:
+                return Bucket()
+            if hh not in cache:
+                data = loader(hh)
+                if data is None:
+                    raise RuntimeError(f"missing bucket {hh}")
+                try:
+                    b = Bucket.deserialize(data)
+                except Exception as e:
+                    raise RuntimeError(
+                        f"corrupt bucket {hh}: {e}") from e
+                if b.hash().hex() != hh:
+                    raise RuntimeError(f"bucket hash mismatch for {hh}")
+                cache[hh] = b
+            return cache[hh]
+
+        for lv, (ch, sh) in zip(bl.levels, level_hashes):
+            lv.curr = load(ch)
+            lv.snap = load(sh)
+        return bl
+
 
 def _bucket_find(bucket: Bucket, kb: bytes):
     """Binary search by key (cached keys tuple)."""
@@ -222,19 +283,97 @@ def _bucket_find(bucket: Bucket, kb: bytes):
 
 
 class BucketManager:
-    """Owns the bucket list; tracks merges + GC bookkeeping
-    (ref src/bucket/BucketManagerImpl.cpp, simplified: in-memory buckets,
-    no disk files — the persistence story goes through history snapshots)."""
+    """Owns the bucket list + the on-disk bucket store (ref
+    src/bucket/BucketManagerImpl.cpp).  Buckets are content-addressed
+    files <dir>/bucket-<hex>.xdr so a node restart (or catchup) can
+    reassume state from the persisted level hashes."""
 
-    def __init__(self, app=None):
+    def __init__(self, app=None, bucket_dir: Optional[str] = None):
         self.app = app
         self.bucket_list = BucketList()
+        self.bucket_dir = bucket_dir
+        if bucket_dir:
+            import os
+
+            os.makedirs(bucket_dir, exist_ok=True)
+        self._saved: set = set()
 
     def add_batch(self, ledger_seq: int, changes) -> bytes:
-        return self.bucket_list.add_batch(ledger_seq, changes)
+        h = self.bucket_list.add_batch(ledger_seq, changes)
+        if self.bucket_dir:
+            self._persist_new_buckets()
+        return h
 
     def get_bucket_list_hash(self) -> bytes:
         return self.bucket_list.hash()
 
     def snapshot_state(self) -> Dict[bytes, object]:
         return self.bucket_list.all_live_entries()
+
+    # -- disk store ---------------------------------------------------------
+
+    def _bucket_path(self, hh: str) -> str:
+        import os
+
+        return os.path.join(self.bucket_dir, f"bucket-{hh}.xdr")
+
+    def _persist_new_buckets(self) -> None:
+        """Write newly-appeared buckets to disk.  Deletion of
+        no-longer-referenced files is deliberately NOT done here: GC runs
+        via gc_unreferenced() only after the new level hashes are durably
+        committed (LedgerManager._store_bucket_state), else a crash
+        between the two leaves persisted hashes pointing at deleted
+        files."""
+        import os
+
+        for lv in self.bucket_list.levels:
+            for b in (lv.curr, lv.snap):
+                hh = b.hash().hex()
+                if hh == "00" * 32 or hh in self._saved:
+                    continue
+                path = self._bucket_path(hh)
+                if not os.path.exists(path):
+                    tmp = path + ".tmp"
+                    with open(tmp, "wb") as f:
+                        f.write(b.serialize())
+                    os.replace(tmp, path)
+                self._saved.add(hh)
+
+    def gc_unreferenced(self) -> None:
+        """Delete bucket files the current (durably committed) bucket list
+        no longer references (ref forgetUnreferencedBuckets)."""
+        import os
+
+        if self.bucket_dir is None:
+            return
+        live = {b.hash().hex()
+                for lv in self.bucket_list.levels
+                for b in (lv.curr, lv.snap)}
+        for hh in list(self._saved - live):
+            self._saved.discard(hh)
+            try:
+                os.remove(self._bucket_path(hh))
+            except OSError:
+                pass
+
+    def load_bucket_bytes(self, hh: str) -> Optional[bytes]:
+        if hh == "00" * 32:
+            return b""
+        try:
+            with open(self._bucket_path(hh), "rb") as f:
+                return f.read()
+        except (FileNotFoundError, TypeError):
+            return None
+
+    def restore_from_level_hashes(
+            self, level_hashes: Sequence[Tuple[str, str]]) -> None:
+        self.bucket_list = BucketList.restore(
+            level_hashes, self.load_bucket_bytes)
+        self._saved = {hh for pair in level_hashes for hh in pair
+                       if hh != "00" * 32}
+
+    def assume_bucket_list(self, bucket_list: BucketList) -> None:
+        """Adopt a bucket list built by catchup; persist its buckets."""
+        self.bucket_list = bucket_list
+        if self.bucket_dir:
+            self._persist_new_buckets()

@@ -1,0 +1,7 @@
+"""Catchup subsystem: archive-based rejoin (ref src/catchup —
+SURVEY.md §2.8, §3.4)."""
+from .catchup_work import (  # noqa: F401
+    ApplyBucketsWork, ApplyCheckpointsWork, CatchupConfiguration,
+    CatchupManager, CatchupWork, DownloadVerifyLedgerChainWork,
+    GetHistoryArchiveStateWork,
+)

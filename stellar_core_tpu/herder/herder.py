@@ -213,19 +213,31 @@ class Herder:
         cfg = app.config
         qset = self._build_qset(cfg)
         self.scp = SCP(self.driver, cfg.node_id(),
-                       cfg.NODE_IS_VALIDATOR, qset)
+                       cfg.NODE_IS_VALIDATOR, qset,
+                       tally_backend=getattr(cfg, "SCP_TALLY_BACKEND",
+                                             "host"))
         self.pending_envelopes.add_qset(qset)
         self._scp_timers: Dict = {}
         self.trigger_timer = VirtualTimer(app.clock)
         self.on_externalized: List[Callable] = []
         self._tracking_slot: Optional[int] = None
+        # consensus failure detection (ref HerderImpl.cpp:432 +
+        # CONSENSUS_STUCK_TIMEOUT_SECONDS, Herder.cpp:9): no externalize
+        # within the stuck window => NOT_TRACKING + periodic recovery
+        self.tracking_timer = VirtualTimer(app.clock)
+        self.out_of_sync_timer = VirtualTimer(app.clock)
+        self.lost_sync_count = 0
 
     @staticmethod
     def _build_qset(cfg):
         if cfg.QUORUM_SET:
+            inner = [
+                make_qset(s["threshold"], s["validators"])
+                for s in cfg.QUORUM_SET.get("inner_sets", [])]
             return make_qset(
                 cfg.QUORUM_SET["threshold"],
-                cfg.QUORUM_SET["validators"])
+                cfg.QUORUM_SET["validators"],
+                inner=inner)
         # standalone: self-quorum
         return make_qset(1, [cfg.node_id()])
 
@@ -235,12 +247,61 @@ class Herder:
         self.state = HerderState.TRACKING
         if not self.app.config.MANUAL_CLOSE:
             self._arm_trigger()
+            self._arm_tracking_timer()
 
     def _arm_trigger(self) -> None:
         cfg = self.app.config
         self.trigger_timer.expires_from_now(
             cfg.EXP_LEDGER_TIMESPAN_SECONDS)
         self.trigger_timer.async_wait(self.trigger_next_ledger)
+
+    # -- failure detection / out-of-sync recovery ---------------------------
+
+    def _stuck_timeout(self) -> float:
+        cfg = self.app.config
+        if cfg.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING:
+            # scale with the accelerated close cadence
+            return max(cfg.EXP_LEDGER_TIMESPAN_SECONDS * 7, 5.0)
+        return float(CONSENSUS_STUCK_TIMEOUT_SECONDS)
+
+    def _arm_tracking_timer(self) -> None:
+        """Re-armed on every externalize; firing means consensus is stuck
+        (ref trackingHeartBeat / CONSENSUS_STUCK_TIMEOUT_SECONDS)."""
+        self.tracking_timer.cancel()
+        self.tracking_timer.expires_from_now(self._stuck_timeout())
+        self.tracking_timer.async_wait(self._herder_out_of_sync)
+
+    def _herder_out_of_sync(self) -> None:
+        """ref herderOutOfSync: lost consensus — flip to NOT_TRACKING and
+        start periodic recovery."""
+        if self.state != HerderState.TRACKING:
+            return
+        self.state = HerderState.NOT_TRACKING
+        self.lost_sync_count += 1
+        self.app.metrics.counter("herder.lost-sync").inc()
+        self._out_of_sync_recovery()
+
+    def _out_of_sync_recovery(self) -> None:
+        """ref outOfSyncRecovery (HerderImpl.cpp:432): re-ask peers for
+        SCP state from our LCL and rebroadcast our latest messages, on a
+        short period until tracking resumes."""
+        if self.state == HerderState.TRACKING:
+            return
+        om = self.app.overlay_manager
+        if om is not None:
+            from ..xdr import overlay_types as O
+
+            seq = self.app.ledger_manager.last_closed_seq()
+            for p in list(om.authenticated.values()):
+                p.send_message(O.StellarMessage.make(
+                    O.MessageType.GET_SCP_STATE, seq))
+            for slot_index in sorted(self.scp.slots):
+                for env in self.scp.get_latest_messages_send(slot_index):
+                    om.broadcast_scp(env)
+        period = max(self.app.config.EXP_LEDGER_TIMESPAN_SECONDS, 2.0)
+        self.out_of_sync_timer.cancel()
+        self.out_of_sync_timer.expires_from_now(period)
+        self.out_of_sync_timer.async_wait(self._out_of_sync_recovery)
 
     # -- tx admission (north-star hot path #1) ------------------------------
 
@@ -318,8 +379,14 @@ class Herder:
         tx_set = self.pending_envelopes.get_tx_set(sv.txSetHash)
         if tx_set is None:
             raise RuntimeError("externalized value with unknown tx set")
+        back_in_sync = self.state != HerderState.TRACKING
         self.state = HerderState.TRACKING
         self._tracking_slot = slot_index
+        if back_in_sync:
+            self.out_of_sync_timer.cancel()
+        if not self.app.config.MANUAL_CLOSE:
+            self._arm_tracking_timer()
+        self._persist_scp_history(slot_index)
         lm = self.app.ledger_manager
         if slot_index == lm.last_closed_seq() + 1:
             lm.close_ledger(LedgerCloseData(slot_index, tx_set, sv))
@@ -340,6 +407,42 @@ class Herder:
         self.tx_queue.shift(lm.root)
         self.scp.purge_slots(
             max(0, slot_index - SCP_EXTRA_LOOKBACK_LEDGERS), slot_index)
+
+    def check_quorum_intersection(self, qmap=None):
+        """Run the quorum-intersection checker over the tracked network
+        (ref CommandHandler 'quorum?intersection=true' +
+        QuorumIntersectionChecker::create).  qmap defaults to the latest
+        slot's per-node quorum sets plus the local node."""
+        from .quorum_intersection import check_quorum_intersection
+
+        if qmap is None:
+            qmap = {self.scp.local_node.node_id:
+                    self.scp.local_node.qset}
+            slot_idx = self.scp.get_high_slot_index()
+            slot = self.scp.get_slot(slot_idx, create=False)
+            if slot is not None:
+                for env in slot.latest_envelopes():
+                    node = env.statement.nodeID.value
+                    q = slot.qset_from_statement(env.statement)
+                    if q is not None:
+                        qmap[node] = q
+        use_device = self.app.config.CRYPTO_BACKEND == "tpu"
+        return check_quorum_intersection(qmap, use_device=use_device)
+
+    def _persist_scp_history(self, slot_index: int) -> None:
+        """Persist the slot's SCP envelopes for audit + history publish
+        (ref HerderPersistenceImpl::saveSCPHistory)."""
+        slot = self.scp.slots.get(slot_index)
+        if slot is None:
+            return
+        db = self.app.database
+        for env in slot.latest_envelopes():
+            db.execute(
+                "INSERT INTO scphistory(nodeid, ledgerseq, envelope) "
+                "VALUES(?,?,?)",
+                (env.statement.nodeID.value, slot_index,
+                 T.SCPEnvelope.encode(env)))
+        db.commit()
 
     # -- manual close (test/standalone) -------------------------------------
 

@@ -1,0 +1,197 @@
+"""QuorumIntersectionChecker: does every pair of quorums in the network
+intersect?  (ref src/herder/QuorumIntersectionChecker.h:16,
+QuorumIntersectionCheckerImpl.cpp — QBitSet graph :373, Tarjan SCC, the
+MinQuorumEnumerator powerset scan :124/:391/:407.)
+
+TPU-first redesign (BASELINE config #3): instead of the reference's
+recursive single-subset scan over BitSets, candidate subsets are contracted
+to their maximal quorums in device-sized batches
+(ops/quorum.contract_batch — a boolean-matmul fixpoint).  Disjoint quorums
+exist iff some subset S contracts to a non-empty quorum Q whose complement
+also contracts non-empty: every quorum is its own contraction, so scanning
+all subsets of the main SCC is exhaustive.
+
+The subset space is 2^|SCC|; the scan caps at MAX_SCAN_NODES (the
+reference similarly treats the checker as an offline/background tool with
+an interrupt flag for big networks).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ..scp import local_node as LN
+
+MAX_SCAN_NODES = 20  # 2^20 subsets ~ 1M contractions, chunked on device
+CHUNK = 1 << 14
+
+
+class QuorumIntersectionResult:
+    def __init__(self, ok: bool, split: Optional[Tuple[Set[bytes],
+                                                       Set[bytes]]] = None,
+                 scanned: int = 0, scc_size: int = 0):
+        self.ok = ok
+        self.split = split
+        self.scanned = scanned
+        self.scc_size = scc_size
+
+
+def tarjan_scc(nodes: List[bytes],
+               edges: Dict[bytes, Set[bytes]]) -> List[List[bytes]]:
+    """Tarjan's strongly-connected components, iterative
+    (ref src/util/TarjanSCCCalculator.h)."""
+    index: Dict[bytes, int] = {}
+    lowlink: Dict[bytes, int] = {}
+    on_stack: Set[bytes] = set()
+    stack: List[bytes] = []
+    sccs: List[List[bytes]] = []
+    counter = [0]
+
+    for start in nodes:
+        if start in index:
+            continue
+        work = [(start, iter(sorted(edges.get(start, ()))))]
+        index[start] = lowlink[start] = counter[0]
+        counter[0] += 1
+        stack.append(start)
+        on_stack.add(start)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = lowlink[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(edges.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    lowlink[v] = min(lowlink[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                lowlink[parent] = min(lowlink[parent], lowlink[v])
+            if lowlink[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                sccs.append(comp)
+    return sccs
+
+
+def check_quorum_intersection(qmap: Dict[bytes, object],
+                              use_device: bool = True
+                              ) -> QuorumIntersectionResult:
+    """qmap: node id -> XDR SCPQuorumSet.  Nodes with unknown (None) qsets
+    are excluded, like the reference's missing-qset handling."""
+    qmap = {n: q for n, q in qmap.items() if q is not None}
+    nodes = sorted(qmap)
+    if not nodes:
+        return QuorumIntersectionResult(True)
+
+    # dependency graph: n -> nodes its qset references (ref buildGraph)
+    edges = {n: (LN.qset_nodes(q) & set(nodes)) for n, q in qmap.items()}
+    sccs = tarjan_scc(nodes, edges)
+    # quorums in two different SCCs are disjoint by construction — the
+    # reference fails fast in that case and otherwise restricts the scan
+    # to the single quorum-bearing SCC (ref
+    # networkEnjoysQuorumIntersection checking exactly one SCC has
+    # quorums)
+    quorum_sccs = []
+    for comp in sorted(sccs, key=len, reverse=True):
+        q = _contract_host(set(comp), qmap)
+        if q:
+            quorum_sccs.append((sorted(comp), q))
+    if not quorum_sccs:
+        return QuorumIntersectionResult(True, scc_size=0)
+    if len(quorum_sccs) > 1:
+        return QuorumIntersectionResult(
+            False, (quorum_sccs[0][1], quorum_sccs[1][1]),
+            0, len(quorum_sccs[0][0]))
+    main_scc = quorum_sccs[0][0]
+    if len(main_scc) > MAX_SCAN_NODES:
+        raise ValueError(
+            f"quorum intersection scan capped at {MAX_SCAN_NODES} nodes "
+            f"(SCC has {len(main_scc)})")
+
+    n = len(main_scc)
+    universe = set(main_scc)
+    plains = []
+    for node in main_scc:
+        p = LN.qset_to_plain(qmap[node])
+        if p is None:
+            use_device = False  # >2-level qsets: host contraction only
+            break
+        # restrict memberships to the SCC (outside nodes never vote here)
+        thr, vals, inners = p
+        plains.append((thr, [v for v in vals if v in universe],
+                       [(t, [v for v in vs if v in universe])
+                        for t, vs in inners]))
+
+    scanned = 0
+    if use_device:
+        import jax.numpy as jnp
+
+        from ..ops.quorum import build_qset_tensor, contract_batch
+
+        qsets = build_qset_tensor(plains, main_scc)
+        total = 1 << n
+        for base in range(0, total, CHUNK):
+            count = min(CHUNK, total - base)
+            idx = np.arange(base, base + count, dtype=np.uint32)
+            members = ((idx[:, None] >> np.arange(n)) & 1).astype(np.bool_)
+            contracted = np.asarray(
+                contract_batch(qsets, jnp.asarray(members)))
+            scanned += count
+            nonempty = contracted.any(axis=1)
+            if not nonempty.any():
+                continue
+            # complements of the found quorums, contracted in turn
+            quorums = np.unique(contracted[nonempty], axis=0)
+            comp = ~quorums
+            comp_contracted = np.asarray(
+                contract_batch(qsets, jnp.asarray(comp)))
+            bad = comp_contracted.any(axis=1)
+            if bad.any():
+                i = int(np.argmax(bad))
+                q1 = {main_scc[j] for j in range(n) if quorums[i, j]}
+                q2 = {main_scc[j] for j in range(n)
+                      if comp_contracted[i, j]}
+                return QuorumIntersectionResult(
+                    False, (q1, q2), scanned, n)
+        return QuorumIntersectionResult(True, None, scanned, n)
+
+    # host path (exact, any nesting depth)
+    total = 1 << n
+    for mask in range(total):
+        s = {main_scc[j] for j in range(n) if (mask >> j) & 1}
+        q1 = _contract_host(s, qmap)
+        scanned += 1
+        if not q1:
+            continue
+        q2 = _contract_host(universe - q1, qmap)
+        if q2:
+            return QuorumIntersectionResult(False, (q1, q2), scanned, n)
+    return QuorumIntersectionResult(True, None, scanned, n)
+
+
+def _contract_host(members: Set[bytes],
+                   qmap: Dict[bytes, object]) -> Set[bytes]:
+    """Host contraction to the maximal quorum inside ``members``
+    (ref contractToMaximalQuorum)."""
+    cur = set(members)
+    while True:
+        nxt = {n for n in cur
+               if n in qmap and LN.is_quorum_slice(qmap[n], cur)}
+        if nxt == cur:
+            return cur
+        cur = nxt

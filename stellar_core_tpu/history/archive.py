@@ -1,0 +1,160 @@
+"""History archives: checkpoint file layout + HistoryArchiveState (HAS)
+(ref src/history/HistoryArchive.{h,cpp}, src/history/readme.md:8-30).
+
+An archive is a directory tree (the reference's operator-configured
+get/put command templates collapse to local filesystem ops here — the
+test-suite model, ref HistoryConfigurator; remote transports slot in
+behind the same get_file/put_file seam):
+
+    .well-known/stellar-history.json          root HAS
+    history/xx/yy/zz/history-XXXXXXXX.json    per-checkpoint HAS
+    ledger/xx/yy/zz/ledger-XXXXXXXX.xdr.gz    LedgerHeaderHistoryEntry*
+    transactions/.../transactions-XXXXXXXX.xdr.gz  TransactionHistoryEntry*
+    results/.../results-XXXXXXXX.xdr.gz       TransactionHistoryResultEntry*
+    scp/.../scp-XXXXXXXX.xdr.gz               SCPHistoryEntry*
+    bucket/xx/yy/zz/bucket-<hex>.xdr.gz       BucketEntry* (by content hash)
+
+XXXXXXXX is the checkpoint ledger seq in 8-hex-digit form; xx/yy/zz are its
+first three byte pairs (ref fs::hexDir layout).
+"""
+from __future__ import annotations
+
+import gzip
+import json
+import os
+from typing import Dict, List, Optional
+
+HAS_VERSION = 1
+
+
+def checkpoint_name(seq: int) -> str:
+    return f"{seq:08x}"
+
+
+def _hex_dir(name: str) -> str:
+    return os.path.join(name[0:2], name[2:4], name[4:6])
+
+
+def category_path(category: str, name: str, ext: str) -> str:
+    return os.path.join(category, _hex_dir(name),
+                        f"{category}-{name}{ext}")
+
+
+class HistoryArchiveState:
+    """The HAS JSON: checkpoint ledger + the 11 levels' bucket hashes
+    (ref HistoryArchiveState; 'next' merge-futures are always clear here —
+    merges are synchronous in this framework)."""
+
+    def __init__(self, current_ledger: int = 0,
+                 buckets: Optional[List[Dict[str, str]]] = None,
+                 network_passphrase: str = ""):
+        self.version = HAS_VERSION
+        self.server = "stellar-core-tpu"
+        self.current_ledger = current_ledger
+        self.network_passphrase = network_passphrase
+        self.buckets = buckets or [
+            {"curr": "00" * 32, "snap": "00" * 32}
+            for _ in range(11)]
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "version": self.version,
+            "server": self.server,
+            "currentLedger": self.current_ledger,
+            "networkPassphrase": self.network_passphrase,
+            "currentBuckets": [
+                {"curr": b["curr"], "snap": b["snap"],
+                 "next": {"state": 0}}
+                for b in self.buckets],
+        }, indent=2)
+
+    @classmethod
+    def from_json(cls, s: str) -> "HistoryArchiveState":
+        d = json.loads(s)
+        has = cls(d["currentLedger"],
+                  [{"curr": b["curr"], "snap": b["snap"]}
+                   for b in d["currentBuckets"]],
+                  d.get("networkPassphrase", ""))
+        has.server = d.get("server", "")
+        return has
+
+    def all_bucket_hashes(self) -> List[str]:
+        out = []
+        for b in self.buckets:
+            out.append(b["curr"])
+            out.append(b["snap"])
+        return out
+
+
+class HistoryArchive:
+    """One archive backed by a local directory."""
+
+    def __init__(self, name: str, root: str):
+        self.name = name
+        self.root = root
+
+    # -- raw file ops (the get/put command-template seam) -------------------
+
+    def _abs(self, rel: str) -> str:
+        return os.path.join(self.root, rel)
+
+    def put_file(self, rel: str, data: bytes) -> None:
+        path = self._abs(rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path + ".tmp", "wb") as f:
+            f.write(data)
+        os.replace(path + ".tmp", path)
+
+    def get_file(self, rel: str) -> Optional[bytes]:
+        try:
+            with open(self._abs(rel), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def has_file(self, rel: str) -> bool:
+        return os.path.exists(self._abs(rel))
+
+    # -- typed helpers ------------------------------------------------------
+
+    def put_xdr_gz(self, category: str, name: str, payload: bytes) -> None:
+        self.put_file(category_path(category, name, ".xdr.gz"),
+                      gzip.compress(payload))
+
+    def get_xdr_gz(self, category: str, name: str) -> Optional[bytes]:
+        raw = self.get_file(category_path(category, name, ".xdr.gz"))
+        return gzip.decompress(raw) if raw is not None else None
+
+    def put_bucket(self, hash_hex: str, payload: bytes) -> None:
+        if hash_hex == "00" * 32:
+            return
+        rel = category_path("bucket", hash_hex, ".xdr.gz")
+        if not self.has_file(rel):  # content-addressed: write once
+            self.put_file(rel, gzip.compress(payload))
+
+    def get_bucket(self, hash_hex: str) -> Optional[bytes]:
+        if hash_hex == "00" * 32:
+            return b""
+        raw = self.get_file(category_path("bucket", hash_hex, ".xdr.gz"))
+        return gzip.decompress(raw) if raw is not None else None
+
+    def put_has(self, has: HistoryArchiveState) -> None:
+        name = checkpoint_name(has.current_ledger)
+        data = has.to_json().encode()
+        self.put_file(category_path("history", name, ".json"), data)
+        self.put_file(os.path.join(".well-known",
+                                   "stellar-history.json"), data)
+
+    def get_root_has(self) -> Optional[HistoryArchiveState]:
+        raw = self.get_file(os.path.join(".well-known",
+                                         "stellar-history.json"))
+        if raw is None:
+            return None
+        return HistoryArchiveState.from_json(raw.decode())
+
+    def get_checkpoint_has(self, seq: int) -> Optional[HistoryArchiveState]:
+        raw = self.get_file(category_path(
+            "history", checkpoint_name(seq), ".json"))
+        if raw is None:
+            return None
+        return HistoryArchiveState.from_json(raw.decode())

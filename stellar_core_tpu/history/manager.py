@@ -1,0 +1,211 @@
+"""HistoryManager: checkpoint cadence + publishing snapshots to archives
+(ref src/history/HistoryManagerImpl.cpp; StateSnapshot.cpp;
+src/historywork/PublishWork and friends).
+
+Checkpoints close every 64 ledgers (8 under accelerated-time testing, ref
+getCheckpointFrequency :86-96).  A checkpoint covering ledgers
+[first..last] publishes: the header chain, per-ledger tx sets, result
+sets, SCP messages, the bucket files referenced by the current bucket
+list, and the HAS json.  Publishing runs as Work items on the app's
+WorkScheduler (the Work system's first consumer)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..work.work import BasicWork, State
+from ..xdr import types as T
+from ..xdr import xdr_sha256
+from .archive import HistoryArchive, HistoryArchiveState, checkpoint_name
+
+
+class HistoryManager:
+    def __init__(self, app):
+        self.app = app
+        self.archives: List[HistoryArchive] = []
+        for name, path in getattr(app.config, "HISTORY_ARCHIVES", []):
+            self.archives.append(HistoryArchive(name, path))
+        self.published_checkpoints = 0
+        # replay (catchup) closes must not re-publish into the archive
+        # being read — see ApplyCheckpointsWork
+        self.suppress_publish = False
+
+    # -- crash-safe publish queue (persistentstate row; ref the reference
+    # persisting its publish queue inside the ledger-commit txn,
+    # LedgerManagerImpl.cpp:877-881) -----------------------------------------
+
+    def _load_queue(self) -> List[int]:
+        import json
+
+        row = self.app.database.execute(
+            "SELECT state FROM persistentstate WHERE "
+            "statename='publishqueue'").fetchone()
+        return json.loads(row[0]) if row else []
+
+    def _store_queue(self, queue: List[int]) -> None:
+        import json
+
+        self.app.database.execute(
+            "INSERT INTO persistentstate(statename, state) "
+            "VALUES('publishqueue', ?) ON CONFLICT(statename) "
+            "DO UPDATE SET state=excluded.state", (json.dumps(queue),))
+        self.app.database.commit()
+
+    # -- cadence (ref getCheckpointFrequency) -------------------------------
+
+    def checkpoint_frequency(self) -> int:
+        if self.app.config.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING:
+            return 8
+        return 64
+
+    def is_last_ledger_in_checkpoint(self, seq: int) -> bool:
+        return (seq + 1) % self.checkpoint_frequency() == 0
+
+    def checkpoint_containing(self, seq: int) -> int:
+        """The checkpoint ledger (last seq) whose range contains seq."""
+        f = self.checkpoint_frequency()
+        return ((seq // f) + 1) * f - 1
+
+    def first_ledger_in_checkpoint(self, checkpoint: int) -> int:
+        f = self.checkpoint_frequency()
+        first = checkpoint - f + 1
+        return max(first, 1)
+
+    def latest_checkpoint_at_or_before(self, seq: int) -> int:
+        f = self.checkpoint_frequency()
+        c = self.checkpoint_containing(seq)
+        return c if c <= seq else c - f
+
+    # -- close-path hooks (ref maybeQueueHistoryCheckpoint /
+    # publishQueuedHistory, called from closeLedger) -------------------------
+
+    def maybe_queue_history_checkpoint(self, seq: int) -> None:
+        if not self.archives or self.suppress_publish:
+            return
+        if self.is_last_ledger_in_checkpoint(seq):
+            q = self._load_queue()
+            if seq not in q:
+                q.append(seq)
+                self._store_queue(q)
+
+    def publish_queued_history(self) -> None:
+        """Run a PublishWork per queued checkpoint.  The queue is a
+        persistentstate row, so a crash between queueing and publishing
+        re-publishes on restart.  Local-directory archives publish in one
+        crank; the loop bound covers retries (a remote transport would
+        leave the work pending on the scheduler instead of draining
+        here)."""
+        from ..work.work import State
+
+        if self.suppress_publish:
+            return
+        queue = self._load_queue()
+        remaining = list(queue)
+        for seq in queue:
+            w = PublishWork(self.app, seq)
+            # crank the work directly: publishing can run from inside a
+            # ledger close, and cranking the app-wide scheduler here would
+            # re-enter whatever work (e.g. a CatchupWork) triggered that
+            # close
+            w.start()
+            for _ in range(100):
+                w.crank()
+                if w.state not in (State.RUNNING, State.WAITING):
+                    break
+            if w.state == State.SUCCESS:
+                remaining.remove(seq)
+        if remaining != queue:
+            self._store_queue(remaining)
+
+    # -- snapshot construction (ref StateSnapshot) --------------------------
+
+    def write_snapshot(self, checkpoint: int) -> None:
+        """Write one checkpoint's files to every configured archive."""
+        app = self.app
+        first = self.first_ledger_in_checkpoint(checkpoint)
+        name = checkpoint_name(checkpoint)
+
+        headers = []
+        for seq in range(first, checkpoint + 1):
+            row = app.database.execute(
+                "SELECT data FROM ledgerheaders WHERE ledgerseq=?",
+                (seq,)).fetchone()
+            if row is None:
+                raise RuntimeError(f"missing header {seq} for publish")
+            hdr = T.LedgerHeader.decode(row[0])
+            headers.append(T.LedgerHeaderHistoryEntry.make(
+                hash=xdr_sha256(T.LedgerHeader, hdr), header=hdr,
+                ext=T.LedgerHeaderHistoryEntry.fields[2][1].make(0)))
+        ledger_blob = b"".join(
+            T.LedgerHeaderHistoryEntry.encode(h) for h in headers)
+
+        tx_blob_parts = []
+        res_blob_parts = []
+        for i, seq in enumerate(range(first, checkpoint + 1)):
+            rows = app.database.execute(
+                "SELECT txbody, txresult FROM txhistory WHERE ledgerseq=? "
+                "ORDER BY txindex", (seq,)).fetchall()
+            if not rows:
+                continue
+            prev_hash = headers[i].header.previousLedgerHash
+            txs = [T.TransactionEnvelope.decode(r[0]) for r in rows]
+            tx_blob_parts.append(T.TransactionHistoryEntry.encode(
+                T.TransactionHistoryEntry.make(
+                    ledgerSeq=seq,
+                    txSet=T.TransactionSet.make(
+                        previousLedgerHash=prev_hash, txs=txs),
+                    ext=T.TransactionHistoryEntry.fields[2][1].make(0))))
+            results = [T.TransactionResultPair.decode(r[1]) for r in rows]
+            res_blob_parts.append(T.TransactionHistoryResultEntry.encode(
+                T.TransactionHistoryResultEntry.make(
+                    ledgerSeq=seq,
+                    txResultSet=T.TransactionResultSet.make(
+                        results=results),
+                    ext=T.TransactionHistoryResultEntry.fields[2][1]
+                    .make(0))))
+
+        scp_parts = []
+        for seq in range(first, checkpoint + 1):
+            rows = app.database.execute(
+                "SELECT envelope FROM scphistory WHERE ledgerseq=? ",
+                (seq,)).fetchall()
+            for (raw,) in rows:
+                scp_parts.append(raw)
+
+        level_hashes = app.bucket_manager.bucket_list.level_hashes()
+        has = HistoryArchiveState(
+            checkpoint,
+            [{"curr": c, "snap": s} for c, s in level_hashes],
+            app.config.NETWORK_PASSPHRASE)
+
+        for archive in self.archives:
+            archive.put_xdr_gz("ledger", name, ledger_blob)
+            archive.put_xdr_gz("transactions", name,
+                               b"".join(tx_blob_parts))
+            archive.put_xdr_gz("results", name, b"".join(res_blob_parts))
+            archive.put_xdr_gz("scp", name, b"".join(scp_parts))
+            for lv in app.bucket_manager.bucket_list.levels:
+                for b in (lv.curr, lv.snap):
+                    if not b.is_empty():
+                        archive.put_bucket(b.hash().hex(), b.serialize())
+            archive.put_has(has)
+        self.published_checkpoints += 1
+
+
+class PublishWork(BasicWork):
+    """One checkpoint's publish as a Work item (ref
+    src/historywork/PublishWork.h — collapsed to a single step since the
+    archive is a local directory; remote transports would expand this to
+    the reference's per-file work sequence)."""
+
+    def __init__(self, app, checkpoint: int):
+        super().__init__(f"publish-{checkpoint:08x}",
+                         max_retries=BasicWork.RETRY_A_FEW)
+        self.app = app
+        self.checkpoint = checkpoint
+
+    def on_run(self) -> State:
+        try:
+            self.app.history_manager.write_snapshot(self.checkpoint)
+            return State.SUCCESS
+        except Exception:
+            return State.FAILURE

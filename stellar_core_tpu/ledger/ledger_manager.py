@@ -205,11 +205,40 @@ class LedgerManager:
         new_header = self.root.header()
         self._lcl_hash = xdr_sha256(T.LedgerHeader, new_header)
         self._store_lcl(new_header)
+        self._store_bucket_state()
         self.metrics.counter("ledger.ledger.count").set_count(
             new_header.ledgerSeq)
+        # history: queue + publish checkpoints (ref closeLedger :890-899 —
+        # queueing is crash-safe because the header row committed above in
+        # the same SQL database)
+        hm = self.app.history_manager
+        if hm is not None:
+            hm.maybe_queue_history_checkpoint(new_header.ledgerSeq)
+            hm.publish_queued_history()
         # meta stream for downstream consumers
         self.app.emit_ledger_close_meta(
             new_header, tx_set, tx_result_metas, upgrade_metas)
+
+    def _store_bucket_state(self) -> None:
+        """Persist the bucket-list level hashes so a restarted node can
+        reassume its state from the on-disk buckets (ref PersistentState
+        kHistoryArchiveState).  Only meaningful with an on-disk bucket
+        store; GC of unreferenced bucket files runs AFTER this commit so a
+        crash can never leave the persisted hashes pointing at deleted
+        files."""
+        import json
+
+        bm = self.app.bucket_manager
+        if bm.bucket_dir is None:
+            return
+        hashes = bm.bucket_list.level_hashes()
+        self.app.database.execute(
+            "INSERT INTO persistentstate(statename, state) "
+            "VALUES('bucketlist', ?) ON CONFLICT(statename) "
+            "DO UPDATE SET state=excluded.state",
+            (json.dumps(hashes),))
+        self.app.database.commit()
+        bm.gc_unreferenced()
 
     def _collect_changes(self, ltx
                          ) -> List[Tuple[bytes, Optional[object], bool]]:

@@ -30,14 +30,19 @@ class Application:
         self.metrics = MetricsRegistry(clock)
         self.scheduler = Scheduler(clock)
         self.database = open_database(config.DATABASE)
-        self.bucket_manager = BucketManager(self)
+        self.bucket_manager = BucketManager(
+            self, bucket_dir=getattr(config, "BUCKET_DIR_PATH_REAL", None))
         self.invariants = InvariantManager(config.INVARIANT_CHECKS)
         self.ledger_manager = LedgerManager(self)
         self.work_scheduler = WorkScheduler(clock)
         self.herder = Herder(self)
         self.overlay_manager = None   # wired by overlay.setup (optional)
-        self.catchup_manager = _BufferingCatchup(self)
-        self.history_manager = None
+        from ..history import HistoryManager
+
+        self.history_manager = HistoryManager(self)
+        from ..catchup import CatchupManager
+
+        self.catchup_manager = CatchupManager(self)
         self._meta_stream: List = []
         self._started = False
 
@@ -50,12 +55,40 @@ class Application:
                    config or Config())
 
     def start(self) -> None:
-        if not self.ledger_manager.load_last_known_ledger():
+        if self.ledger_manager.load_last_known_ledger():
+            self._restore_bucket_state()
+        else:
             self.ledger_manager.start_new_ledger()
         self.herder.start()
         if self.overlay_manager is not None:
             self.overlay_manager.start()
+        self.history_manager.publish_queued_history()
         self._started = True
+
+    def _restore_bucket_state(self) -> None:
+        """Reassume the bucket list from the persisted level hashes + the
+        on-disk bucket files (ref ApplicationImpl::start :788 ->
+        loadLastKnownLedger -> AssumeStateWork)."""
+        import json
+
+        if self.bucket_manager.bucket_dir is None:
+            # no on-disk bucket store configured: nothing to restore from
+            # (state hashes can't be rebuilt; catchup from an archive is
+            # the rejoin path for such nodes)
+            return
+        row = self.database.execute(
+            "SELECT state FROM persistentstate WHERE "
+            "statename='bucketlist'").fetchone()
+        if row is None:
+            return
+        level_hashes = [tuple(p) for p in json.loads(row[0])]
+        self.bucket_manager.restore_from_level_hashes(level_hashes)
+        hdr = self.ledger_manager.last_closed_header()
+        if self.bucket_manager.get_bucket_list_hash() != \
+                hdr.bucketListHash:
+            raise RuntimeError(
+                "restored bucket list does not match the last closed "
+                "header's bucketListHash")
 
     def crank(self, block: bool = False) -> int:
         n = self.clock.crank(block)
@@ -132,22 +165,3 @@ class Application:
         }
 
 
-class _BufferingCatchup:
-    """Minimal CatchupManager stand-in: buffers out-of-order externalized
-    ledgers and replays them when contiguous (full archive-based catchup
-    lands with the history subsystem)."""
-
-    def __init__(self, app):
-        self.app = app
-        self.buffered = {}
-
-    def buffer_externalized(self, seq, tx_set, sv) -> None:
-        from ..ledger.ledger_manager import LedgerCloseData
-
-        self.buffered[seq] = (tx_set, sv)
-        lm = self.app.ledger_manager
-        while lm.last_closed_seq() + 1 in self.buffered:
-            s = lm.last_closed_seq() + 1
-            ts, value = self.buffered.pop(s)
-            lm.close_ledger(LedgerCloseData(s, ts, value))
-            self.app.herder.ledger_closed(s)

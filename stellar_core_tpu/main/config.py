@@ -41,6 +41,17 @@ class Config:
         # storage
         self.DATABASE: str = kw.get("DATABASE", ":memory:")
         self.BUCKET_DIR_PATH: str = kw.get("BUCKET_DIR_PATH", "buckets")
+        # set to a real directory to persist bucket files (restart support)
+        self.BUCKET_DIR_PATH_REAL: Optional[str] = kw.get(
+            "BUCKET_DIR_PATH_REAL")
+        # [(name, local-directory-path)] history archives to publish
+        # to / catch up from (ref HISTORY config blocks)
+        self.HISTORY_ARCHIVES: List[tuple] = kw.get("HISTORY_ARCHIVES", [])
+
+        # SCP federated-tally backend: "host" (exact python), "tensor"
+        # (batched device kernels, ops/quorum.py), or "both" (tensor with
+        # the host oracle asserting equality — differential testing)
+        self.SCP_TALLY_BACKEND: str = kw.get("SCP_TALLY_BACKEND", "host")
 
         # consensus cadence (ref Herder.cpp:7-18)
         self.EXP_LEDGER_TIMESPAN_SECONDS: float = kw.get(

@@ -132,6 +132,35 @@ def is_quorum(local_qs: QSetTensor, qsets: QSetTensor,
     return jnp.any(q) & local_ok
 
 
+def contract_batch(qsets: QSetTensor, members: jnp.ndarray) -> jnp.ndarray:
+    """Batched greatest-fixpoint contraction: members (B, N) -> (B, N).
+
+    The engine of the quorum-intersection scan (BASELINE config #3):
+    thousands of candidate subsets contract in one device program.  A
+    fixpoint is reached in <= N iterations (each iteration can only drop
+    nodes), so a fixed-trip fori_loop keeps the program shape static
+    (ref contractToMaximalQuorum,
+    src/herder/QuorumIntersectionCheckerImpl.cpp:407)."""
+    n = members.shape[-1]
+
+    def step(_, m):
+        # for each batch row: node i stays iff its slice is satisfied by
+        # the row.  is_quorum_slice(qsets, sets) with qsets batched over N
+        # and sets (B, N) needs per-node evaluation of every row:
+        # hits (N_qsets) x (B rows) -> evaluate as (B, N): node i vs row b
+        s = m.astype(jnp.int32)                       # (B, N)
+        top = jnp.einsum("in,bn->bi", qsets.top_mem.astype(jnp.int32), s)
+        inner_ct = jnp.einsum(
+            "ikn,bn->bik", qsets.inner_mem.astype(jnp.int32), s)
+        inner_ok = (inner_ct >= qsets.inner_thr[None, :, :]) & (
+            qsets.inner_thr[None, :, :] > 0)
+        hits = top + inner_ok.sum(axis=-1, dtype=jnp.int32)   # (B, N)
+        sat = hits >= qsets.top_thr[None, :]
+        return m & sat
+
+    return jax.lax.fori_loop(0, n, step, members)
+
+
 # ---------------------------------------------------------------------------
 # federated-voting tallies (the BallotProtocol hot loop, batched)
 # ---------------------------------------------------------------------------

@@ -12,10 +12,14 @@ from .slot import EnvelopeState, Slot
 
 class SCP:
     def __init__(self, driver: SCPDriver, node_id: bytes, is_validator: bool,
-                 qset):
+                 qset, tally_backend: str = "host"):
         self.driver = driver
         self.local_node = LocalNode(node_id, qset, is_validator)
         self.slots: Dict[int, Slot] = {}
+        # "host" | "tensor" | "both": route federated tallies through the
+        # batched device kernels (ops/quorum.py), optionally with the host
+        # oracle asserting equality (see scp/tally.py)
+        self.tally_backend = tally_backend
 
     # -- slots -------------------------------------------------------------
 

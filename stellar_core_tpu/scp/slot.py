@@ -30,6 +30,13 @@ class Slot:
         # historical statements for audit (ref mStatementsHistory)
         self.statements_history: List = []
         self.got_v_blocking = False
+        backend = getattr(scp, "tally_backend", "host")
+        if backend != "host":
+            from .tally import TallyEngine
+
+            self.tally = TallyEngine(self, backend)
+        else:
+            self.tally = None
 
     # -- plumbing ----------------------------------------------------------
 
@@ -91,6 +98,11 @@ class Slot:
     def get_latest_composite_candidate(self) -> Optional[bytes]:
         return self.nomination.latest_composite
 
+    def latest_envelopes(self) -> list:
+        """Per-node latest ballot envelopes (HerderPersistence's audit
+        record, ref Slot::getLatestMessagesSend)."""
+        return list(self.ballot.latest_envelopes.values())
+
     # -- federated voting --------------------------------------------------
 
     def federated_accept(
@@ -100,7 +112,16 @@ class Slot:
         envelopes: Dict[bytes, object],
     ) -> bool:
         """accept iff a v-blocking set accepts, or a quorum (w.r.t. the
-        local node) votes-or-accepts (ref Slot::federatedAccept)."""
+        local node) votes-or-accepts (ref Slot::federatedAccept).
+
+        Routed through the batched tensor kernels (ops/quorum.py) when the
+        SCP instance runs with tally backend "tensor"/"both"; host math
+        otherwise and for >2-level quorum sets."""
+        if self.tally is not None:
+            verdict = self.tally.federated_accept(
+                voted_predicate, accepted_predicate, envelopes)
+            if verdict is not None:
+                return verdict
         accepted_nodes = {
             n for n, env in envelopes.items()
             if accepted_predicate(env.statement)
@@ -112,18 +133,23 @@ class Slot:
             if accepted_predicate(env.statement)
             or voted_predicate(env.statement)
         }
-        return self._is_quorum(vote_or_accept, envelopes)
+        return self._host_is_quorum(vote_or_accept, envelopes)
 
     def federated_ratify(
         self, voted_predicate: Callable, envelopes: Dict[bytes, object]
     ) -> bool:
+        if self.tally is not None:
+            verdict = self.tally.federated_ratify(
+                voted_predicate, envelopes)
+            if verdict is not None:
+                return verdict
         voted = {
             n for n, env in envelopes.items()
             if voted_predicate(env.statement)
         }
-        return self._is_quorum(voted, envelopes)
+        return self._host_is_quorum(voted, envelopes)
 
-    def _is_quorum(self, nodes, envelopes) -> bool:
+    def _host_is_quorum(self, nodes, envelopes) -> bool:
         def get_qset(node_id: bytes):
             env = envelopes.get(node_id)
             if env is None:

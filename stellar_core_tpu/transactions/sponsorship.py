@@ -62,6 +62,10 @@ def map_sponsorship_result(res: int, low_reserve_result):
     if res == SponsorshipResult.TOO_MANY_SPONSORING:
         return T.OperationResult.make(
             T.OperationResultCode.opTOO_MANY_SPONSORING)
+    # TOO_MANY_SPONSORED is unreachable through valid operations (every
+    # sponsored-count increment is bounded by ACCOUNT_SUBENTRY_LIMIT or
+    # MAX_SIGNERS, both << UINT32_MAX); the reference likewise falls
+    # through and throws (ref RevokeSponsorshipOpFrame.cpp:66-70)
     raise SponsorshipError(f"unexpected sponsorship result {res}")
 
 

@@ -1,0 +1,82 @@
+"""Consensus failure detection + out-of-sync recovery: a node cut off from
+its quorum flips TRACKING -> NOT_TRACKING on the stuck timeout, then
+recovers via GET_SCP_STATE after reconnecting
+(ref HerderImpl.cpp:432 outOfSyncRecovery, Herder.cpp:9
+CONSENSUS_STUCK_TIMEOUT_SECONDS; VERDICT r2 next-round task #10)."""
+from stellar_core_tpu.herder.herder import HerderState
+from stellar_core_tpu.overlay.peer import make_loopback_pair
+from stellar_core_tpu.simulation.simulation import Simulation, _ids, _seeds
+
+
+def _live_sim(n=3, threshold=2, archive_dir=None):
+    sim = Simulation(network_passphrase="recovery net")
+    seeds = _seeds(n)
+    ids = _ids(seeds)
+    qset = {"threshold": threshold, "validators": ids}
+    kw = {}
+    if archive_dir is not None:
+        # one shared archive: publishes are content-addressed and
+        # deterministic across nodes, rejoiners catch up from it
+        kw["HISTORY_ARCHIVES"] = [("shared", str(archive_dir))]
+    for s in seeds:
+        sim.add_node(s, qset, MANUAL_CLOSE=False, **kw)
+    for i in range(n):
+        for j in range(i + 1, n):
+            sim.add_connection(ids[i], ids[j])
+    return sim, ids
+
+
+def _disconnect(app):
+    for p in list(app.overlay_manager.authenticated.values()):
+        partner = p.partner
+        p.close("test disconnect")
+        partner.close("test disconnect")
+
+
+def test_cut_off_node_goes_not_tracking_and_recovers(tmp_path):
+    sim, ids = _live_sim(archive_dir=tmp_path / "archive")
+    sim.start_all_nodes()
+    a, b, c = (sim.nodes[i] for i in ids)
+
+    # the network closes ledgers on its own cadence
+    assert sim.crank_until(
+        lambda: sim.have_all_externalized(3), timeout=120)
+
+    _disconnect(c)
+    seq_at_cut = c.ledger_manager.last_closed_seq()
+
+    # A+B (threshold 2) keep closing; C starves and flips NOT_TRACKING
+    # once the stuck window passes
+    assert sim.crank_until(
+        lambda: c.herder.state == HerderState.NOT_TRACKING, timeout=200)
+    assert c.herder.lost_sync_count == 1
+    assert a.ledger_manager.last_closed_seq() > seq_at_cut
+    assert c.ledger_manager.last_closed_seq() <= seq_at_cut + 1
+
+    # reconnect: the out-of-sync recovery timer asks peers for SCP state,
+    # C applies the missed recent slots and resumes tracking
+    make_loopback_pair(a, c)
+    make_loopback_pair(b, c)
+    assert sim.crank_until(
+        lambda: c.herder.state == HerderState.TRACKING, timeout=200)
+    target = a.ledger_manager.last_closed_seq()
+    assert sim.crank_until(
+        lambda: c.ledger_manager.last_closed_seq() >= target, timeout=200)
+    # hashes agree at the shared height
+    h_c = c.ledger_manager.last_closed_hash()
+    row = a.database.execute(
+        "SELECT data FROM ledgerheaders WHERE ledgerseq=?",
+        (c.ledger_manager.last_closed_seq(),)).fetchone()
+    from stellar_core_tpu.xdr import types as T, xdr_sha256
+
+    assert h_c == xdr_sha256(T.LedgerHeader, T.LedgerHeader.decode(row[0]))
+
+
+def test_healthy_network_never_loses_sync():
+    sim, ids = _live_sim()
+    sim.start_all_nodes()
+    assert sim.crank_until(
+        lambda: sim.have_all_externalized(4), timeout=200)
+    for app in sim.nodes.values():
+        assert app.herder.state == HerderState.TRACKING
+        assert app.herder.lost_sync_count == 0

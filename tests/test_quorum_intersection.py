@@ -1,0 +1,137 @@
+"""QuorumIntersectionChecker vs brute force on small graphs
+(ref test model: src/herder/test/QuorumIntersectionTests.cpp — hand-built
+and randomized topologies)."""
+import itertools
+import random
+
+import pytest
+
+from stellar_core_tpu.herder.quorum_intersection import (
+    check_quorum_intersection, tarjan_scc, _contract_host,
+)
+from stellar_core_tpu.scp import local_node as LN
+
+
+def ids(n):
+    return [bytes([i]) * 32 for i in range(n)]
+
+
+def qset(threshold, validators, inner=()):
+    return LN.make_qset(threshold, validators,
+                        [LN.make_qset(t, v) for t, v in inner])
+
+
+def brute_force_disjoint(qmap):
+    """Exhaustive reference: every subset that is a quorum, against every
+    other; disjoint pair -> False."""
+    nodes = sorted(qmap)
+    quorums = []
+    for r in range(1, len(nodes) + 1):
+        for combo in itertools.combinations(nodes, r):
+            s = set(combo)
+            if all(LN.is_quorum_slice(qmap[n], s) for n in s):
+                quorums.append(s)
+    for a in quorums:
+        for b in quorums:
+            if not (a & b):
+                return False
+    return True
+
+
+class TestTarjan:
+    def test_two_components(self):
+        a, b, c, d = ids(4)
+        edges = {a: {b}, b: {a}, c: {d}, d: {c}}
+        sccs = tarjan_scc([a, b, c, d], edges)
+        assert sorted(map(len, sccs)) == [2, 2]
+
+    def test_chain_is_singletons(self):
+        a, b, c = ids(3)
+        edges = {a: {b}, b: {c}, c: set()}
+        sccs = tarjan_scc([a, b, c], edges)
+        assert sorted(map(len, sccs)) == [1, 1, 1]
+
+
+class TestChecker:
+    def test_healthy_core4_intersects(self):
+        n = ids(4)
+        qmap = {x: qset(3, n) for x in n}
+        res = check_quorum_intersection(qmap, use_device=False)
+        assert res.ok and res.scc_size == 4
+
+    def test_split_network_detected(self):
+        n = ids(6)
+        left, right = n[:3], n[3:]
+        qmap = {x: qset(2, left) for x in left}
+        qmap.update({x: qset(2, right) for x in right})
+        res = check_quorum_intersection(qmap, use_device=False)
+        assert not res.ok
+        q1, q2 = res.split
+        assert not (q1 & q2)
+        # each side really is a quorum
+        assert all(LN.is_quorum_slice(qmap[x], q1) for x in q1)
+        assert all(LN.is_quorum_slice(qmap[x], q2) for x in q2)
+
+    def test_majority_threshold_boundary(self):
+        # threshold n/2 exactly: two disjoint halves are quorums
+        n = ids(4)
+        qmap = {x: qset(2, n) for x in n}
+        res = check_quorum_intersection(qmap, use_device=False)
+        assert not res.ok
+        # threshold n/2+1: any two quorums share a node
+        qmap = {x: qset(3, n) for x in n}
+        assert check_quorum_intersection(qmap, use_device=False).ok
+
+    def test_inner_set_orgs(self):
+        n = ids(6)
+        orgs = [(2, n[0:2]), (2, n[2:4]), (2, n[4:6])]
+        qmap = {x: qset(2, [], orgs) for x in n}
+        res = check_quorum_intersection(qmap, use_device=False)
+        assert res.ok == brute_force_disjoint(qmap)
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_randomized_vs_brute_force(self, seed):
+        rng = random.Random(seed)
+        n_nodes = rng.randint(3, 7)
+        nodes = ids(n_nodes)
+        qmap = {}
+        for x in nodes:
+            k = rng.randint(1, n_nodes)
+            members = rng.sample(nodes, k)
+            thr = rng.randint(1, k)
+            qmap[x] = qset(thr, members)
+        res = check_quorum_intersection(qmap, use_device=False)
+        assert res.ok == brute_force_disjoint(qmap), \
+            f"seed {seed}: checker {res.ok}"
+
+    def test_device_path_matches_host(self):
+        """The batched-contraction device scan agrees with the host scan
+        (runs on whatever jax backend the test session has)."""
+        for seed in range(4):
+            rng = random.Random(100 + seed)
+            n_nodes = rng.randint(3, 6)
+            nodes = ids(n_nodes)
+            qmap = {}
+            for x in nodes:
+                k = rng.randint(1, n_nodes)
+                members = rng.sample(nodes, k)
+                qmap[x] = qset(rng.randint(1, k), members)
+            host = check_quorum_intersection(qmap, use_device=False)
+            dev = check_quorum_intersection(qmap, use_device=True)
+            assert host.ok == dev.ok, f"seed {100 + seed}"
+
+    def test_contract_host_fixpoint(self):
+        n = ids(4)
+        qmap = {x: qset(3, n) for x in n}
+        assert _contract_host(set(n), qmap) == set(n)
+        assert _contract_host(set(n[:2]), qmap) == set()
+
+    def test_herder_endpoint(self):
+        from stellar_core_tpu.main import Application, test_config
+        from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+
+        app = Application(VirtualClock(ClockMode.VIRTUAL_TIME),
+                          test_config())
+        app.start()
+        res = app.herder.check_quorum_intersection()
+        assert res.ok  # standalone self-quorum trivially intersects

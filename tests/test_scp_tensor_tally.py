@@ -1,0 +1,85 @@
+"""Live SCP with the tensor tally path (ops/quorum.py) — multi-node
+networks externalize with every federated accept/ratify routed through the
+batched kernels AND differential-checked against the host oracle
+("both" mode raises TallyMismatch on any divergence).
+(VERDICT r2 next-round task #4; BASELINE config #5.)"""
+import pytest
+
+from stellar_core_tpu.crypto import SecretKey, sha256
+from stellar_core_tpu.simulation.simulation import Simulation, _ids, _seeds
+
+
+def _tensor_sim(n: int, threshold=None) -> Simulation:
+    sim = Simulation(network_passphrase="tensor tally net")
+    seeds = _seeds(n)
+    ids = _ids(seeds)
+    thr = threshold if threshold is not None else n - (n - 1) // 3
+    qset = {"threshold": thr, "validators": ids}
+    for s in seeds:
+        sim.add_node(s, qset, SCP_TALLY_BACKEND="both")
+    for i in range(n):
+        for j in range(i + 1, n):
+            sim.add_connection(ids[i], ids[j])
+    return sim
+
+
+def _tally_stats(sim):
+    tallies = fallbacks = 0
+    for app in sim.nodes.values():
+        for slot in app.herder.scp.slots.values():
+            if slot.tally is not None:
+                tallies += slot.tally.tensor_tallies
+                fallbacks += slot.tally.host_fallbacks
+    return tallies, fallbacks
+
+
+def test_core4_externalizes_with_tensor_tallies():
+    sim = _tensor_sim(4)
+    sim.start_all_nodes()
+    for _ in range(3):
+        assert sim.close_ledger()
+    sim.assert_in_sync()
+    tallies, fallbacks = _tally_stats(sim)
+    assert tallies > 0, "tensor path never engaged"
+    assert fallbacks == 0
+
+
+def test_cycle6_externalizes_with_tensor_tallies():
+    sim = Simulation(network_passphrase="tensor tally net")
+    seeds = _seeds(6)
+    ids = _ids(seeds)
+    for i, s in enumerate(seeds):
+        neighbors = [ids[i], ids[(i - 1) % 6], ids[(i + 1) % 6]]
+        sim.add_node(s, {"threshold": 2, "validators": neighbors},
+                     SCP_TALLY_BACKEND="both")
+    for i in range(6):
+        sim.add_connection(ids[i], ids[(i + 1) % 6])
+    sim.start_all_nodes()
+    for _ in range(2):
+        assert sim.close_ledger()
+    sim.assert_in_sync()
+    tallies, _ = _tally_stats(sim)
+    assert tallies > 0
+
+
+def test_inner_set_qsets_tensor_path():
+    """Org-grouped (2-level) quorum sets exercise the inner-set tensor
+    columns: 3 orgs x 2 validators, threshold 2-of-3 orgs, each org
+    2-of-2."""
+    sim = Simulation(network_passphrase="tensor tally net")
+    seeds = _seeds(6)
+    ids = _ids(seeds)
+    orgs = [(2, [ids[0], ids[1]]), (2, [ids[2], ids[3]]),
+            (2, [ids[4], ids[5]])]
+    inner_specs = [{"threshold": t, "validators": v} for t, v in orgs]
+    qset = {"threshold": 2, "validators": [], "inner_sets": inner_specs}
+    for s in seeds:
+        sim.add_node(s, qset, SCP_TALLY_BACKEND="both")
+    for i in range(6):
+        for j in range(i + 1, 6):
+            sim.add_connection(ids[i], ids[j])
+    sim.start_all_nodes()
+    assert sim.close_ledger()
+    sim.assert_in_sync()
+    tallies, fallbacks = _tally_stats(sim)
+    assert tallies > 0 and fallbacks == 0
