@@ -1,79 +1,131 @@
 #!/usr/bin/env python
-"""Headline benchmark: batched ed25519 signature verification throughput.
+"""Headline benchmark: the BASELINE north-star configs, on the real herder
+path.
 
-North star (BASELINE.json): tx-sig verifies/sec on a 100k-tx TxSetFrame,
-target >= 25x the libsodium-class CPU path (here: OpenSSL via `cryptography`,
-the same single-verify architecture as the reference's
-PubKeyUtils::verifySig, ref src/crypto/SecretKey.cpp:428).
+Config #2 — tx-signature verifies/sec on a large TxSetFrame: a
+LoadGenerator-built payment set flows through
+TxSetFrame.collect_signature_batch -> the batched device kernel (the
+--crypto-backend=tpu seam the whole project exists for), against the
+sequential CPU path (OpenSSL via `cryptography`, the same architecture as
+the reference's PubKeyUtils::verifySig, ref src/crypto/SecretKey.cpp:428).
+Config #1-adjacent — ledger-close p50: closes of 1000-tx ledgers through
+the standalone node's full closeLedger path.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Env knobs: BENCH_N (signature batch, default 100000), BENCH_KERNEL
+("pallas"|"xla", default pallas with xla fallback), BENCH_CLOSES (p50
+sample closes, default 8), BENCH_CLOSE_TXS (txs per close, default 1000).
 """
 import json
+import os
+import statistics
 import time
-
-N = 20_000  # scaled-down batch for the driver; kernel throughput is flat in N
 
 
 def main() -> None:
     import numpy as np
 
-    from stellar_core_tpu.crypto import SecretKey, sha256
     from stellar_core_tpu.crypto import ed25519 as ed
+    from stellar_core_tpu.main import Application, test_config
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+    from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
 
-    # build a batch of (pubkey, sig, msg) triples — one keypair signing many
-    # distinct 32-byte tx hashes plus a spread of keys, like a TxSetFrame
-    rng = np.random.default_rng(7)
-    keys = [SecretKey(sha256(b"bench%d" % i)) for i in range(64)]
-    pubs, sigs, msgs = [], [], []
-    for i in range(N):
-        sk = keys[i % len(keys)]
-        msg = sha256(b"tx%d" % i)
-        pubs.append(sk.public_key().raw)
-        sigs.append(sk.sign(msg))
-        msgs.append(msg)
+    n_sigs = int(os.environ.get("BENCH_N", "100000"))
+    n_closes = int(os.environ.get("BENCH_CLOSES", "8"))
+    close_txs = int(os.environ.get("BENCH_CLOSE_TXS", "1000"))
+    kernel_pref = os.environ.get("BENCH_KERNEL", "pallas")
 
-    # CPU baseline: sequential OpenSSL verifies (reference architecture)
-    n_base = 2000
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), test_config())
+    app.start()
+    lg = LoadGenerator(app)
+    lg.create_accounts(min(n_sigs, 2000))
+
+    # --- build the TxSetFrame (LoadGenerator PAY mode) ---
+    from stellar_core_tpu.herder.tx_set import TxSetFrame
+    from stellar_core_tpu.xdr import types as T
+
+    envs = lg.generate_payments(n_sigs)
+    xdr_set = T.TransactionSet.make(
+        previousLedgerHash=app.ledger_manager.last_closed_hash(),
+        txs=envs)
+    tx_set = TxSetFrame.make_from_wire(app.config.network_id(), xdr_set)
+    triples, _ = tx_set.collect_signature_batch()
+    n = len(triples)
+    pk = np.frombuffer(b"".join(t[0] for t in triples),
+                       np.uint8).reshape(n, 32)
+    sg = np.frombuffer(b"".join(t[1].ljust(64, b"\x00") for t in triples),
+                       np.uint8).reshape(n, 64)
+    mg = np.frombuffer(b"".join(t[2] for t in triples),
+                       np.uint8).reshape(n, 32)
+
+    # --- CPU baseline: sequential verifies, reference architecture ---
+    n_base = min(2000, n)
     t0 = time.perf_counter()
     for i in range(n_base):
-        assert ed.raw_verify(pubs[i], sigs[i], msgs[i])
+        assert ed.raw_verify(bytes(pk[i]), bytes(sg[i]), bytes(mg[i]))
     cpu_rate = n_base / (time.perf_counter() - t0)
 
-    # TPU path
-    try:
-        from stellar_core_tpu.ops.ed25519_kernel import verify_batch
+    # --- device path ---
+    kernel_used = None
+    verify_batch = None
+    if kernel_pref == "pallas":
+        try:
+            from stellar_core_tpu.ops.ed25519_pallas import \
+                verify_batch as vb
 
-        pk = np.frombuffer(b"".join(pubs), dtype=np.uint8).reshape(N, 32)
-        sg = np.frombuffer(b"".join(sigs), dtype=np.uint8).reshape(N, 64)
-        mg = np.frombuffer(b"".join(msgs), dtype=np.uint8).reshape(N, 32)
-        ok = np.asarray(verify_batch(pk, sg, mg))  # compile + warm
-        assert ok.all(), "kernel rejected valid signatures"
-        t0 = time.perf_counter()
+            ok = np.asarray(vb(pk[:512], sg[:512], mg[:512]))
+            assert ok.all()
+            verify_batch = vb
+            kernel_used = "pallas"
+        except Exception:
+            verify_batch = None
+    if verify_batch is None:
+        from stellar_core_tpu.ops.ed25519_kernel import \
+            verify_batch as vb
+
+        verify_batch = vb
+        kernel_used = "xla"
+
+    ok = np.asarray(verify_batch(pk, sg, mg))  # compile + warm
+    assert ok.all(), f"kernel rejected {int((~ok).sum())} valid signatures"
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
         ok = np.asarray(verify_batch(pk, sg, mg))
-        dt = time.perf_counter() - t0
-        tpu_rate = N / dt
-        print(
-            json.dumps(
-                {
-                    "metric": "ed25519_verifies_per_sec_batched",
-                    "value": round(tpu_rate, 1),
-                    "unit": "verifies/s",
-                    "vs_baseline": round(tpu_rate / cpu_rate, 2),
-                }
-            )
-        )
-    except Exception as e:  # kernel not ready yet — report CPU baseline
-        print(
-            json.dumps(
-                {
-                    "metric": "ed25519_verifies_per_sec_cpu_ref",
-                    "value": round(cpu_rate, 1),
-                    "unit": "verifies/s",
-                    "vs_baseline": 1.0,
-                    "note": f"tpu kernel unavailable: {type(e).__name__}: {e}",
-                }
-            )
-        )
+    dt = (time.perf_counter() - t0) / reps
+    tpu_rate = n / dt
+
+    # --- ledger-close p50 through the full node close path ---
+    # fresh LoadGenerator: the signature batch above advanced the first
+    # generator's sequence tracker without applying anything, so its next
+    # envelopes would be rejected as sequence gaps
+    lg2 = LoadGenerator(app)
+    lg2.create_accounts(max(close_txs, 1), prefix=b"close-bench")
+    close_times = []
+    for _ in range(n_closes):
+        admitted = sum(
+            1 for env in lg2.generate_payments(close_txs)
+            if app.herder.recv_transaction(env) == 0)
+        assert admitted == close_txs, \
+            f"only {admitted}/{close_txs} txs admitted"
+        t0 = time.perf_counter()
+        app.herder.manual_close()
+        close_times.append((time.perf_counter() - t0) * 1000)
+    close_p50 = statistics.median(close_times) if close_times else None
+
+    print(json.dumps({
+        "metric": "ed25519_verifies_per_sec_txset",
+        "value": round(tpu_rate, 1),
+        "unit": "verifies/s",
+        "vs_baseline": round(tpu_rate / cpu_rate, 2),
+        "cpu_verifies_per_sec": round(cpu_rate, 1),
+        "n_signatures": n,
+        "kernel": kernel_used,
+        "ledger_close_p50_ms": (round(close_p50, 1)
+                                if close_p50 is not None else None),
+        "close_txs": close_txs,
+    }))
 
 
 if __name__ == "__main__":

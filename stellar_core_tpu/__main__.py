@@ -1,0 +1,7 @@
+"""python -m stellar_core_tpu <subcommand> — the node CLI
+(ref src/main/main.cpp -> CommandLine)."""
+import sys
+
+from .main.command_line import main
+
+sys.exit(main())

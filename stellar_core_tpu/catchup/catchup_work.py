@@ -144,6 +144,10 @@ class ApplyBucketsWork(BasicWork):
         app.bucket_manager.assume_bucket_list(bl)
         app.ledger_manager._lcl_hash = self.header_entry.hash
         app.ledger_manager._store_lcl(header)
+        # keep the persisted restart state in step with the assumed bucket
+        # list — a restart before the next close would otherwise restore
+        # the pre-catchup level hashes and refuse to boot
+        app.ledger_manager._store_bucket_state()
         return State.SUCCESS
 
 

@@ -28,6 +28,8 @@ class HistoryManager:
         # replay (catchup) closes must not re-publish into the archive
         # being read — see ApplyCheckpointsWork
         self.suppress_publish = False
+        # buckets referenced by queued-but-unpublished checkpoints
+        self._pinned = {}
 
     # -- crash-safe publish queue (persistentstate row; ref the reference
     # persisting its publish queue inside the ledger-commit txn,
@@ -79,13 +81,25 @@ class HistoryManager:
     # publishQueuedHistory, called from closeLedger) -------------------------
 
     def maybe_queue_history_checkpoint(self, seq: int) -> None:
+        """Queue entries snapshot the bucket-list level hashes AT the
+        checkpoint ledger — a crash-delayed republish must not stamp the
+        HAS with whatever the bucket list looks like later (the archived
+        header's bucketListHash would never match and minimal catchup to
+        that checkpoint would be permanently broken).  The referenced
+        buckets are pinned in memory until published (ref
+        PublishQueueBuckets retaining files via refcounts)."""
         if not self.archives or self.suppress_publish:
             return
         if self.is_last_ledger_in_checkpoint(seq):
             q = self._load_queue()
-            if seq not in q:
-                q.append(seq)
+            if not any(e[0] == seq for e in q):
+                hashes = self.app.bucket_manager.bucket_list.level_hashes()
+                q.append([seq, hashes])
                 self._store_queue(q)
+                for lv in self.app.bucket_manager.bucket_list.levels:
+                    for b in (lv.curr, lv.snap):
+                        if not b.is_empty():
+                            self._pinned[b.hash().hex()] = b
 
     def publish_queued_history(self) -> None:
         """Run a PublishWork per queued checkpoint.  The queue is a
@@ -100,8 +114,9 @@ class HistoryManager:
             return
         queue = self._load_queue()
         remaining = list(queue)
-        for seq in queue:
-            w = PublishWork(self.app, seq)
+        for entry in queue:
+            seq, level_hashes = entry[0], entry[1]
+            w = PublishWork(self.app, seq, level_hashes)
             # crank the work directly: publishing can run from inside a
             # ledger close, and cranking the app-wide scheduler here would
             # re-enter whatever work (e.g. a CatchupWork) triggered that
@@ -112,17 +127,39 @@ class HistoryManager:
                 if w.state not in (State.RUNNING, State.WAITING):
                     break
             if w.state == State.SUCCESS:
-                remaining.remove(seq)
+                remaining.remove(entry)
         if remaining != queue:
             self._store_queue(remaining)
+        # unpin buckets no longer referenced by any queued checkpoint
+        still = {hh for e in remaining for pair in e[1] for hh in pair}
+        for hh in list(self._pinned):
+            if hh not in still:
+                del self._pinned[hh]
 
     # -- snapshot construction (ref StateSnapshot) --------------------------
 
-    def write_snapshot(self, checkpoint: int) -> None:
-        """Write one checkpoint's files to every configured archive."""
+    def _bucket_bytes(self, hh: str):
+        """Serialized bucket for a hash: pinned publish snapshot, the live
+        bucket list, or the on-disk store — None if unavailable."""
+        b = self._pinned.get(hh)
+        if b is not None:
+            return b.serialize()
+        for lv in self.app.bucket_manager.bucket_list.levels:
+            for cand in (lv.curr, lv.snap):
+                if cand.hash().hex() == hh:
+                    return cand.serialize()
+        return self.app.bucket_manager.load_bucket_bytes(hh)
+
+    def write_snapshot(self, checkpoint: int,
+                       level_hashes=None) -> None:
+        """Write one checkpoint's files to every configured archive.
+        level_hashes: the bucket-list state AT the checkpoint (snapshotted
+        at queue time); defaults to the current state for direct calls."""
         app = self.app
         first = self.first_ledger_in_checkpoint(checkpoint)
         name = checkpoint_name(checkpoint)
+        if level_hashes is None:
+            level_hashes = app.bucket_manager.bucket_list.level_hashes()
 
         headers = []
         for seq in range(first, checkpoint + 1):
@@ -171,11 +208,22 @@ class HistoryManager:
             for (raw,) in rows:
                 scp_parts.append(raw)
 
-        level_hashes = app.bucket_manager.bucket_list.level_hashes()
         has = HistoryArchiveState(
             checkpoint,
             [{"curr": c, "snap": s} for c, s in level_hashes],
             app.config.NETWORK_PASSPHRASE)
+
+        bucket_blobs = {}
+        for pair in level_hashes:
+            for hh in pair:
+                if hh == "00" * 32 or hh in bucket_blobs:
+                    continue
+                data = self._bucket_bytes(hh)
+                if data is None:
+                    raise RuntimeError(
+                        f"bucket {hh} for checkpoint {checkpoint} is no "
+                        f"longer available; publish stays queued")
+                bucket_blobs[hh] = data
 
         for archive in self.archives:
             archive.put_xdr_gz("ledger", name, ledger_blob)
@@ -183,10 +231,8 @@ class HistoryManager:
                                b"".join(tx_blob_parts))
             archive.put_xdr_gz("results", name, b"".join(res_blob_parts))
             archive.put_xdr_gz("scp", name, b"".join(scp_parts))
-            for lv in app.bucket_manager.bucket_list.levels:
-                for b in (lv.curr, lv.snap):
-                    if not b.is_empty():
-                        archive.put_bucket(b.hash().hex(), b.serialize())
+            for hh, data in bucket_blobs.items():
+                archive.put_bucket(hh, data)
             archive.put_has(has)
         self.published_checkpoints += 1
 
@@ -197,15 +243,17 @@ class PublishWork(BasicWork):
     archive is a local directory; remote transports would expand this to
     the reference's per-file work sequence)."""
 
-    def __init__(self, app, checkpoint: int):
+    def __init__(self, app, checkpoint: int, level_hashes=None):
         super().__init__(f"publish-{checkpoint:08x}",
                          max_retries=BasicWork.RETRY_A_FEW)
         self.app = app
         self.checkpoint = checkpoint
+        self.level_hashes = level_hashes
 
     def on_run(self) -> State:
         try:
-            self.app.history_manager.write_snapshot(self.checkpoint)
+            self.app.history_manager.write_snapshot(
+                self.checkpoint, self.level_hashes)
             return State.SUCCESS
         except Exception:
             return State.FAILURE

@@ -45,6 +45,10 @@ class Application:
         self.catchup_manager = CatchupManager(self)
         self._meta_stream: List = []
         self._started = False
+        # real-socket mode (enable_tcp): io service + listeners
+        self.tcp_io = None
+        self.peer_door = None
+        self.http_server = None
 
     # -- lifecycle (ref ApplicationImpl::start :772) ------------------------
 
@@ -62,6 +66,8 @@ class Application:
         self.herder.start()
         if self.overlay_manager is not None:
             self.overlay_manager.start()
+        if self.tcp_io is not None:
+            self.connect_known_peers()
         self.history_manager.publish_queued_history()
         self._started = True
 
@@ -95,11 +101,43 @@ class Application:
         while self.scheduler.run_one():
             n += 1
         self.work_scheduler.crank()
+        if self.tcp_io is not None:
+            n += self.tcp_io.poll()
         return n
+
+    def enable_tcp(self) -> None:
+        """Real-socket mode: TCP overlay transport + PeerDoor + admin HTTP
+        (ref ApplicationImpl start wiring OverlayManager/PeerDoor/
+        CommandHandler).  Outbound connections go to KNOWN_PEERS."""
+        from ..overlay.manager import OverlayManager
+        from ..overlay.tcp_peer import PeerDoor, TCPIOService
+        from .http_server import AdminHttpServer
+
+        self.tcp_io = TCPIOService()
+        if self.overlay_manager is None:
+            self.overlay_manager = OverlayManager(self)
+        if self.config.PEER_PORT:
+            self.peer_door = PeerDoor(self, self.config.PEER_PORT)
+            self.tcp_io.register(self.peer_door.sock,
+                                 self.peer_door.on_acceptable)
+        if self.config.HTTP_PORT is not None:
+            self.http_server = AdminHttpServer(self,
+                                               self.config.HTTP_PORT)
+
+    def connect_known_peers(self) -> None:
+        from ..overlay.tcp_peer import connect_to
+
+        for addr in self.config.KNOWN_PEERS:
+            host, _, port = addr.partition(":")
+            connect_to(self, host or "127.0.0.1", int(port or 11625))
 
     def graceful_stop(self) -> None:
         if self.overlay_manager is not None:
             self.overlay_manager.shutdown()
+        if self.peer_door is not None:
+            self.peer_door.close()
+        if self.http_server is not None:
+            self.http_server.close()
         self.clock.stop()
 
     # -- cross-subsystem plumbing ------------------------------------------

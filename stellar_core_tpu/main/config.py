@@ -106,7 +106,30 @@ class Config:
             from ..crypto.strkey import decode_ed25519_seed
 
             kw["NODE_SEED"] = decode_ed25519_seed(kw["NODE_SEED"])
+        qs = kw.get("QUORUM_SET")
+        if qs:
+            kw["QUORUM_SET"] = cls._decode_qset_spec(qs)
+        if "HISTORY_ARCHIVES" in kw:
+            kw["HISTORY_ARCHIVES"] = [
+                tuple(a) for a in kw["HISTORY_ARCHIVES"]]
         return cls(**kw)
+
+    @staticmethod
+    def _decode_qset_spec(qs: dict) -> dict:
+        """TOML quorum sets name validators by strkey (G...); decode to
+        raw keys recursively."""
+        from ..crypto.strkey import decode_ed25519_public_key
+
+        def conv(v):
+            return (decode_ed25519_public_key(v)
+                    if isinstance(v, str) else v)
+
+        out = {"threshold": qs["threshold"],
+               "validators": [conv(v) for v in qs.get("validators", [])]}
+        if qs.get("inner_sets"):
+            out["inner_sets"] = [Config._decode_qset_spec(s)
+                                 for s in qs["inner_sets"]]
+        return out
 
 
 def test_config(n: int = 0, **kw) -> Config:

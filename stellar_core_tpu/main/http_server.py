@@ -1,0 +1,188 @@
+"""Admin HTTP API: the operator/Horizon-facing command endpoints
+(ref src/main/CommandHandler.cpp:89-129 route table; lib/http's tiny
+embedded server).
+
+Single-threaded like the reference: a non-blocking listener on the app's
+TCPIOService, parsed with a minimal GET handler.  Routes: info, metrics,
+peers, quorum (?intersection=true), scp, tx?blob=<base64-xdr>,
+manualclose, ll?level=..., bans.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import urllib.parse
+from typing import Callable, Dict, Optional
+
+
+class CommandHandler:
+    """Route registry + implementations (ref CommandHandler::CommandHandler
+    registering handlers :89-129)."""
+
+    def __init__(self, app):
+        self.app = app
+        self.routes: Dict[str, Callable] = {
+            "info": self.info,
+            "metrics": self.metrics,
+            "peers": self.peers,
+            "quorum": self.quorum,
+            "scp": self.scp,
+            "tx": self.tx,
+            "manualclose": self.manualclose,
+            "ll": self.log_level,
+        }
+
+    def handle(self, path: str, params: Dict[str, str]) -> tuple:
+        """-> (status, json-serializable body)."""
+        fn = self.routes.get(path.strip("/"))
+        if fn is None:
+            return 404, {"error": f"unknown command {path!r}"}
+        try:
+            return fn(params)
+        except Exception as e:  # operator endpoint: report, don't crash
+            return 500, {"error": f"{type(e).__name__}: {e}"}
+
+    # -- endpoints ----------------------------------------------------------
+
+    def info(self, params):
+        return 200, {"info": self.app.get_json_info()}
+
+    def metrics(self, params):
+        return 200, {"metrics": self.app.metrics.snapshot()}
+
+    def peers(self, params):
+        om = self.app.overlay_manager
+        if om is None:
+            return 200, {"authenticated_peers": []}
+        return 200, {"authenticated_peers": [
+            {"id": pid.hex(), **p.get_stats()}
+            for pid, p in om.authenticated.items()]}
+
+    def quorum(self, params):
+        if params.get("intersection") == "true":
+            res = self.app.herder.check_quorum_intersection()
+            body = {"intersection": res.ok,
+                    "scanned_subsets": res.scanned,
+                    "scc_size": res.scc_size}
+            if res.split:
+                body["split"] = [[n.hex() for n in side]
+                                 for side in res.split]
+            return 200, body
+        qset = self.app.herder.scp.local_node.qset
+        return 200, {"qset": {
+            "threshold": qset.threshold,
+            "validators": [v.value.hex() for v in qset.validators],
+            "inner_sets": len(qset.innerSets)}}
+
+    def scp(self, params):
+        scp = self.app.herder.scp
+        out = {}
+        for idx in sorted(scp.slots)[-2:]:
+            out[str(idx)] = scp.slots[idx].get_entire_state()
+        return 200, {"slots": out}
+
+    def tx(self, params):
+        """Submit a transaction: tx?blob=<base64 TransactionEnvelope XDR>
+        (ref CommandHandler::tx :117)."""
+        from ..herder.tx_queue import TransactionQueue
+        from ..xdr import types as T
+
+        blob = params.get("blob")
+        if not blob:
+            return 400, {"error": "missing blob"}
+        try:
+            env = T.TransactionEnvelope.decode(
+                base64.b64decode(blob.encode()))
+        except Exception:
+            return 400, {"status": "ERROR", "error": "malformed envelope"}
+        res = self.app.herder.recv_transaction(env)
+        names = {TransactionQueue.ADD_STATUS_PENDING: "PENDING",
+                 TransactionQueue.ADD_STATUS_DUPLICATE: "DUPLICATE",
+                 TransactionQueue.ADD_STATUS_BANNED: "TRY_AGAIN_LATER",
+                 TransactionQueue.ADD_STATUS_TRY_AGAIN_LATER:
+                 "TRY_AGAIN_LATER",
+                 TransactionQueue.ADD_STATUS_ERROR: "ERROR"}
+        return 200, {"status": names.get(res, "ERROR")}
+
+    def manualclose(self, params):
+        if not self.app.config.MANUAL_CLOSE:
+            return 400, {"error": "manual close not enabled"}
+        seq = self.app.herder.manual_close()
+        return 200, {"ledger": seq}
+
+    def log_level(self, params):
+        from ..utils import logging as L
+
+        level = params.get("level")
+        if level:
+            L.set_log_level(level, params.get("partition"))
+        return 200, {"levels": L.get_log_levels()}
+
+
+class AdminHttpServer:
+    """Non-blocking single-request-per-connection HTTP/1.0 server on the
+    app's TCPIOService."""
+
+    def __init__(self, app, port: int = 0):
+        self.app = app
+        self.handler = CommandHandler(app)
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", port))
+        self.port = self.sock.getsockname()[1]
+        self.sock.listen(16)
+        self.sock.setblocking(False)
+        app.tcp_io.register(self.sock, self._on_acceptable)
+
+    def _on_acceptable(self) -> None:
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except (BlockingIOError, InterruptedError, OSError):
+                return
+            conn.setblocking(False)
+            buf = bytearray()
+
+            def on_readable(conn=conn, buf=buf):
+                try:
+                    chunk = conn.recv(65536)
+                except (BlockingIOError, InterruptedError):
+                    return
+                except OSError:
+                    self.app.tcp_io.unregister(conn)
+                    conn.close()
+                    return
+                if chunk:
+                    buf.extend(chunk)
+                if b"\r\n\r\n" in buf or not chunk:
+                    self._respond(conn, bytes(buf))
+
+            self.app.tcp_io.register(conn, on_readable)
+
+    def _respond(self, conn, request: bytes) -> None:
+        self.app.tcp_io.unregister(conn)
+        try:
+            line = request.split(b"\r\n", 1)[0].decode("latin-1")
+            _method, target, *_ = line.split(" ")
+            parsed = urllib.parse.urlparse(target)
+            params = {k: v[0] for k, v in
+                      urllib.parse.parse_qs(parsed.query).items()}
+            status, body = self.handler.handle(parsed.path, params)
+        except Exception as e:
+            status, body = 400, {"error": str(e)}
+        payload = json.dumps(body, indent=1).encode()
+        reasons = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                   500: "Internal Server Error"}
+        head = (f"HTTP/1.0 {status} {reasons.get(status, 'OK')}\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(payload)}\r\n\r\n").encode()
+        try:
+            conn.sendall(head + payload)
+        except OSError:
+            pass
+        conn.close()
+
+    def close(self) -> None:
+        self.app.tcp_io.unregister(self.sock)
+        self.sock.close()

@@ -123,32 +123,42 @@ class _KC:
 # field ops on int32[..., NL, B] values (inside-kernel helpers)
 # ---------------------------------------------------------------------------
 
-def _weak_carry(x, passes: int = 2):
-    """Parallel carry passes; limb-21 carry folds to limb 0 with weight
-    19*2^9 (2^264 == FOLD * 2^252... see field25519.weak_carry)."""
-    for _ in range(passes):
-        carry = x >> RADIX
-        lo = x - (carry << RADIX)
-        x = lo + jnp.concatenate([carry[NL - 1:NL] * FOLD, carry[:NL - 1]],
-                                 axis=0)
-    return x
+def _rows(x):
+    """Row-index iota of x's shape (for masked single-row updates —
+    scatter does not lower in mosaic, arithmetic masking does)."""
+    return jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
 
 
 def _row_add(x, i: int, v):
-    """x with row i incremented by v (no scatter: concat-based, static i;
-    zero-size slices are not valid mosaic vectors, so skip empty parts)."""
-    parts = []
-    if i > 0:
-        parts.append(x[:i])
-    parts.append((x[i] + v)[None, :])
-    if i + 1 < x.shape[0]:
-        parts.append(x[i + 1:])
-    return jnp.concatenate(parts, axis=0)
+    """x with row i incremented by v (iota-masked; no scatter/concat).
+    v: scalar or (B,)."""
+    v = jnp.asarray(v, jnp.int32)
+    if v.ndim == 1:
+        v = v[None, :]
+    return x + jnp.where(_rows(x) == i, 1, 0) * v
+
+
+def _row_mask(shape_like, i: int, on: int = 1, off: int = 0):
+    return jnp.where(_rows(shape_like) == i, on, off)
+
+
+def _weak_carry(x, passes: int = 2):
+    """Parallel carry passes; limb-21 carry folds to limb 0 with weight
+    19*2^9 (2^264 == FOLD * 2^252... see field25519.weak_carry).
+
+    The wrap is a sublane rotate (hardware-supported in mosaic) times a
+    per-row multiplier that applies FOLD at row 0."""
+    for _ in range(passes):
+        carry = x >> RADIX
+        lo = x - (carry << RADIX)
+        rot = jnp.roll(carry, 1, axis=0)  # row0 <- carry[21]
+        x = lo + rot * _row_mask(rot, 0, FOLD, 1)
+    return x
 
 
 def _pad_rows(x, before: int, after: int):
-    """Zero-pad on the sublane axis via concatenate (mosaic lowers
-    concatenate; jnp.pad/scatter do not lower)."""
+    """Zero-pad on the sublane axis via concatenate (used sparingly; the
+    hot paths use roll/mask forms instead)."""
     parts = []
     if before:
         parts.append(jnp.zeros((before, x.shape[1]), jnp.int32))
@@ -161,32 +171,37 @@ def _pad_rows(x, before: int, after: int):
 def _conv(a, b):
     """Schoolbook 22x22 convolution -> (44, B); mul-safe inputs.
 
-    Pad-and-sum form: scatter-add is not lowerable in Pallas TPU, and the
-    padded full-width adds keep every op on whole (44, B) tiles."""
-    terms = []
-    for i in range(NL):
-        prod = a[i:i + 1, :] * b  # (22, B)
-        terms.append(_pad_rows(prod, i, NL - i))
-    acc = terms[0]
-    for t in terms[1:]:
-        acc = acc + t
+    Roll-and-sum form: b is zero-extended to 44 rows once, then each
+    partial product is a sublane rotate (rows 22..43 of b44 are zero, so
+    the wrap-around region contributes nothing) — no scatter, one concat,
+    22 rotates + multiply-adds on full (44, B) tiles."""
+    b44 = _pad_rows(b, 0, NL)  # (44, B)
+    acc = a[0:1, :] * b44
+    for i in range(1, NL):
+        acc = acc + a[i:i + 1, :] * jnp.roll(b44, i, axis=0)
     return acc
 
 
 def _reduce_product(c):
-    """(44, B) -> (22, B) mul-safe (mirrors field25519._reduce_product)."""
-    c = _pad_rows(c, 0, 2)  # width 46
+    """(44, B) -> (22, B) mul-safe (mirrors field25519._reduce_product).
+
+    Shift-down-by-one carries are sublane rotates; positions whose wrap
+    would be nonzero are masked off."""
+    c = _pad_rows(c, 0, 2)  # width 46; rows 43..45 zero
     for _ in range(2):
         carry = c >> RADIX
         lo = c - (carry << RADIX)
-        c = lo + _pad_rows(carry[:-1], 1, 0)
-    out = _pad_rows(c[:NL], 0, 1) + FOLD * c[NL:45]
+        # carry[45] is provably zero (rows 43..45 hold no products), so
+        # the rotate's wrap contributes nothing
+        c = lo + jnp.roll(carry, 1, axis=0)
+    out = _pad_rows(c[:NL], 0, 1) + FOLD * c[NL:45]  # (23, B)
     for _ in range(3):
         x = out[:NL]
         carry = x >> RADIX
         lo = x - (carry << RADIX)
         top = out[NL] + carry[NL - 1]
-        body = lo + _pad_rows(carry[:NL - 1], 1, 0)
+        rot = jnp.roll(carry, 1, axis=0)          # row0 <- carry[21]
+        body = lo + rot * _row_mask(rot, 0, 0, 1)  # drop the wrap
         body = _row_add(body, 0, FOLD * top)
         out = _pad_rows(body, 0, 1)
     return out[:NL]
@@ -274,7 +289,9 @@ def _freeze(a, C):
     t = _row_add(x, 0, jnp.int32(19))
     t = _carry_seq(t, NL)
     ge = (t[NL - 1] >> 3) > 0
-    t_mod = jnp.concatenate([t[:NL - 1], (t[NL - 1] & 7)[None, :]], axis=0)
+    # mask row 21 down to its low 3 bits (row-masked, no concat)
+    t_mod = t - jnp.where(_rows(t) == NL - 1, 1, 0) * \
+        ((t[NL - 1] - (t[NL - 1] & 7))[None, :])
     return jnp.where(ge[None, :], t_mod, x)
 
 
@@ -324,9 +341,8 @@ def _point_neg(p):
 
 def _ident_pt(bsz):
     zero = jnp.zeros((NL, bsz), dtype=jnp.int32)
-    one = jnp.concatenate(
-        [jnp.ones((1, bsz), dtype=jnp.int32),
-         jnp.zeros((NL - 1, bsz), dtype=jnp.int32)], axis=0)
+    one = jnp.where(
+        jax.lax.broadcasted_iota(jnp.int32, (NL, bsz), 0) == 0, 1, 0)
     return (zero, one, one, zero)
 
 
@@ -454,12 +470,16 @@ def _canonical_y(limbs):
     return (t[..., NL - 1] >> 3) == 0
 
 
-@partial(jax.jit, static_argnames=("interpret",))
-def verify_batch(pubkeys, sigs, msgs, interpret: bool = False):
+@partial(jax.jit, static_argnames=("interpret", "block"))
+def verify_batch(pubkeys, sigs, msgs, interpret: bool = False,
+                 block: int = None):
     """Batched ed25519 verify: (N,32)x(N,64)x(N,32) uint8 -> (N,) bool.
 
     Bit-identical accept/reject to crypto/ed25519_ref.verify (libsodium
-    semantics).  N is padded up to a BLOCK multiple internally."""
+    semantics).  N is padded up to a block multiple internally.  ``block``
+    overrides the per-program batch (interpret-mode tests shrink it; the
+    TPU default is BLOCK)."""
+    BLOCK = block or globals()["BLOCK"]
     pubkeys = jnp.asarray(pubkeys)
     sigs = jnp.asarray(sigs)
     msgs = jnp.asarray(msgs)

@@ -1,0 +1,178 @@
+"""TCPPeer + PeerDoor: the real-socket transport behind the Peer protocol
+(ref src/overlay/TCPPeer.cpp:87 startRead, src/overlay/PeerDoor.h:21).
+
+Framing matches the reference's record marks: each AuthenticatedMessage is
+prefixed by a 4-byte big-endian length with the high bit set (xdrpp
+record-marking, ref TCPPeer::sendMessage/getIncomingMsgLength).
+
+IO model mirrors the reference's single-threaded asio loop: non-blocking
+sockets polled from the application's crank via a selectors.DefaultSelector
+(``TCPIOService.poll``) — no autonomous threads (ref
+docs/architecture.md:24-31)."""
+from __future__ import annotations
+
+import errno
+import selectors
+import socket
+from typing import Dict, Optional
+
+from .peer import Peer, PeerRole
+
+MAX_MESSAGE_SIZE = 16 * 1024 * 1024
+LENGTH_FLAG = 0x80000000
+
+
+class TCPPeer(Peer):
+    """One non-blocking socket connection."""
+
+    def __init__(self, app, role: PeerRole, sock: socket.socket):
+        super().__init__(app, role)
+        self.sock = sock
+        self.sock.setblocking(False)
+        try:
+            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        self._rbuf = b""
+        self._wbuf = b""
+        self._closed = False
+
+    # -- transport surface ---------------------------------------------------
+
+    def transport_write(self, data: bytes) -> None:
+        frame = (len(data) | LENGTH_FLAG).to_bytes(4, "big") + data
+        self._wbuf += frame
+        self._try_flush()
+
+    def _try_flush(self) -> None:
+        while self._wbuf and not self._closed:
+            try:
+                n = self.sock.send(self._wbuf)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                self.close("socket write error")
+                return
+            if n <= 0:
+                return
+            self._wbuf = self._wbuf[n:]
+
+    def on_readable(self) -> None:
+        while not self._closed:
+            try:
+                chunk = self.sock.recv(65536)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                self.close("socket read error")
+                return
+            if not chunk:
+                self.close("peer disconnected")
+                return
+            self._rbuf += chunk
+            if len(chunk) < 65536:
+                break
+        self._drain_frames()
+
+    def _drain_frames(self) -> None:
+        while len(self._rbuf) >= 4 and not self._closed:
+            header = int.from_bytes(self._rbuf[:4], "big")
+            length = header & ~LENGTH_FLAG
+            if length > MAX_MESSAGE_SIZE:
+                self.close("oversized frame")
+                return
+            if len(self._rbuf) < 4 + length:
+                return
+            frame = self._rbuf[4:4 + length]
+            self._rbuf = self._rbuf[4 + length:]
+            self.recv_bytes(frame)
+
+    def close(self, reason: str = "") -> None:
+        if self._closed:
+            return
+        self._closed = True
+        io = getattr(self.app, "tcp_io", None)
+        if io is not None:
+            io.unregister(self.sock)
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        super().close(reason)
+
+
+class PeerDoor:
+    """The listening socket accepting inbound connections
+    (ref src/overlay/PeerDoor.h:21)."""
+
+    def __init__(self, app, port: int):
+        self.app = app
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", port))
+        self.port = self.sock.getsockname()[1]
+        self.sock.listen(16)
+        self.sock.setblocking(False)
+
+    def on_acceptable(self) -> None:
+        while True:
+            try:
+                conn, _addr = self.sock.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            peer = TCPPeer(self.app, PeerRole.ACCEPTOR, conn)
+            self.app.overlay_manager.add_pending_peer(peer)
+            self.app.tcp_io.register(conn, peer.on_readable)
+
+    def close(self) -> None:
+        try:
+            self.app.tcp_io.unregister(self.sock)
+        except Exception:
+            pass
+        self.sock.close()
+
+
+class TCPIOService:
+    """selectors-based readiness polling, pumped from Application.crank
+    (the asio io_context equivalent)."""
+
+    def __init__(self):
+        self.sel = selectors.DefaultSelector()
+        self._cbs: Dict[int, object] = {}
+
+    def register(self, sock: socket.socket, on_readable) -> None:
+        self.sel.register(sock, selectors.EVENT_READ, on_readable)
+
+    def unregister(self, sock: socket.socket) -> None:
+        try:
+            self.sel.unregister(sock)
+        except (KeyError, ValueError):
+            pass
+
+    def poll(self, timeout: float = 0.0) -> int:
+        n = 0
+        for key, _events in self.sel.select(timeout):
+            key.data()
+            n += 1
+        return n
+
+
+def connect_to(app, host: str, port: int) -> Optional[TCPPeer]:
+    """Outbound connection (ref OverlayManager::connectTo)."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setblocking(False)
+    try:
+        sock.connect((host, port))
+    except BlockingIOError:
+        pass
+    except OSError as e:
+        if e.errno not in (errno.EINPROGRESS, errno.EWOULDBLOCK):
+            sock.close()
+            return None
+    peer = TCPPeer(app, PeerRole.INITIATOR, sock)
+    app.overlay_manager.add_pending_peer(peer)
+    app.tcp_io.register(sock, peer.on_readable)
+    peer.start_handshake()
+    return peer

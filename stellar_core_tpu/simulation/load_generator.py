@@ -1,0 +1,101 @@
+"""LoadGenerator: the standard synthetic load source for benchmarks and
+soak tests (ref src/simulation/LoadGenerator.h:28-36 — modes CREATE / PAY;
+the reference drives it via the test-build 'generateload' HTTP endpoint).
+
+CREATE seeds n accounts; PAY builds single-op payment transactions between
+them.  Accounts are written straight into the ledger root (bulk-seeding
+like the reference's createAccounts batches); payments are real signed
+envelopes that flow through whatever admission path the caller uses.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..crypto import SecretKey, sha256
+from ..ledger.ledger_txn import LedgerTxn
+from ..transactions import utils as U
+from ..transactions.signature_checker import signature_hint
+from ..xdr import types as T
+
+CREATE = "create"
+PAY = "pay"
+
+
+class LoadGenerator:
+    def __init__(self, app):
+        self.app = app
+        self.network_id = app.config.network_id()
+        self.accounts: List[SecretKey] = []
+        self._seqs = {}
+
+    # -- CREATE mode --------------------------------------------------------
+
+    def create_accounts(self, n: int, balance: int = 10**9,
+                        prefix: bytes = b"loadgen") -> List[SecretKey]:
+        """Seed n funded accounts directly into the ledger root (bulk;
+        the per-tx path would be n CreateAccount ops)."""
+        root = self.app.ledger_manager.root
+        new = [SecretKey(sha256(prefix + b"-%d" % i)) for i in range(n)]
+        with LedgerTxn(root) as ltx:
+            for sk in new:
+                ltx.put(U.make_account_entry(
+                    sk.public_key().raw, balance, seq_num=0))
+            ltx.commit()
+        self.accounts.extend(new)
+        return new
+
+    # -- PAY mode -----------------------------------------------------------
+
+    def _next_seq(self, sk: SecretKey) -> int:
+        k = sk.public_key().raw
+        if k not in self._seqs:
+            root = self.app.ledger_manager.root
+            with LedgerTxn(root) as ltx:
+                e = ltx.load_account(k)
+                ltx.rollback()
+            self._seqs[k] = e.data.value.seqNum if e else 0
+        self._seqs[k] += 1
+        return self._seqs[k]
+
+    def payment_envelope(self, src: SecretKey, dest: bytes, amount: int,
+                         fee: int = 100):
+        tx = T.Transaction.make(
+            sourceAccount=T.muxed_account(src.public_key().raw),
+            fee=fee,
+            seqNum=self._next_seq(src),
+            cond=T.Preconditions.make(T.PreconditionType.PRECOND_NONE),
+            memo=T.MEMO_NONE_VALUE,
+            operations=[T.Operation.make(
+                sourceAccount=None,
+                body=T.OperationBody.make(
+                    T.OperationType.PAYMENT,
+                    T.PaymentOp.make(destination=T.muxed_account(dest),
+                                     asset=U.asset_native(),
+                                     amount=amount)))],
+            ext=T.Transaction.fields[6][1].make(0))
+        payload = T.TransactionSignaturePayload.make(
+            networkId=self.network_id,
+            taggedTransaction=T.TransactionSignaturePayload.fields[1][1]
+            .make(T.EnvelopeType.ENVELOPE_TYPE_TX, tx))
+        h = sha256(T.TransactionSignaturePayload.encode(payload))
+        sig = T.DecoratedSignature.make(
+            hint=signature_hint(src.public_key().raw),
+            signature=src.sign(h))
+        return T.TransactionEnvelope.make(
+            T.EnvelopeType.ENVELOPE_TYPE_TX,
+            T.TransactionV1Envelope.make(tx=tx, signatures=[sig]))
+
+    def generate_payments(self, n: int,
+                          accounts: Optional[List[SecretKey]] = None
+                          ) -> List:
+        """n one-op payments round-robin across the account pool (each
+        account pays its successor; sequence numbers tracked per source)."""
+        accts = accounts or self.accounts
+        assert accts, "CREATE accounts first"
+        out = []
+        k = len(accts)
+        for i in range(n):
+            src = accts[i % k]
+            dest = accts[(i + 1) % k].public_key().raw
+            out.append(self.payment_envelope(src, dest, 1 + (i % 1000)))
+        return out

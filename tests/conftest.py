@@ -33,3 +33,8 @@ def _reseed_prngs():
     random.seed(12345)
     np.random.seed(12345)
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running (kernel interpret / multiprocess)")

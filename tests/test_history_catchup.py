@@ -105,6 +105,53 @@ class TestPublish:
         assert archive.get_root_has().current_ledger == 7
 
 
+class TestDelayedPublish:
+    def test_delayed_republish_keeps_checkpoint_usable(self, tmp_path):
+        """A publish delayed past further closes (crash-retry) must stamp
+        the HAS with the bucket state AT the checkpoint, not the current
+        one — else minimal catchup to it breaks forever."""
+        arch_dir = tmp_path / "archive"
+        app = make_node(tmp_path, archive_dir=arch_dir)
+        hm = app.history_manager
+        real_publish = hm.publish_queued_history
+        hm.publish_queued_history = lambda: None  # simulate pre-publish crash
+        close_ledgers_with_traffic(app, 12)  # checkpoint 7 queued, unpublished
+        archive = HistoryArchive("test", str(arch_dir))
+        assert archive.get_root_has() is None
+        hm.publish_queued_history = real_publish
+        hm.publish_queued_history()  # delayed: bucket list has moved on
+        has = archive.get_checkpoint_has(7)
+        assert has is not None
+        # the published HAS matches the archived header's bucketListHash
+        blob = archive.get_xdr_gz("ledger", checkpoint_name(7))
+        from stellar_core_tpu.xdr.runtime import Reader
+
+        r = Reader(blob)
+        hdr = None
+        while not r.done():
+            e = T.LedgerHeaderHistoryEntry.unpack(r)
+            if e.header.ledgerSeq == 7:
+                hdr = e.header
+        from stellar_core_tpu.bucket.bucket_list import BucketList
+
+        bl = BucketList.restore(
+            [(b["curr"], b["snap"]) for b in has.buckets],
+            archive.get_bucket)
+        assert bl.hash() == hdr.bucketListHash
+
+        # and a fresh node can minimal-catchup to it
+        app_b = make_node(tmp_path, archive_dir=arch_dir)
+        work = CatchupWork(app_b, app_b.history_manager.archives[0],
+                          CatchupConfiguration(7))
+        work.start()
+        for _ in range(100):
+            work.crank()
+            if work.state not in (State.RUNNING, State.WAITING):
+                break
+        assert work.state == State.SUCCESS
+        assert app_b.ledger_manager.last_closed_seq() == 7
+
+
 class TestRestart:
     def test_stop_start_continues_hash_chain(self, tmp_path):
         db = tmp_path / "node.db"
