@@ -132,8 +132,11 @@ class ApplyBucketsWork(BasicWork):
             ltx.set_header(header)
             ltx.commit()
         app.ledger_manager.root._header_cache = None
+        live = bl.all_live_entries()
+        # invariants on bucket apply (ref checkOnBucketApply)
+        app.invariants.check_on_bucket_apply(live.values(), header)
         with LedgerTxn(app.ledger_manager.root) as ltx:
-            for kb, entry in bl.all_live_entries().items():
+            for kb, entry in live.items():
                 ltx.put(entry)
             ltx.commit()
         # invariant: per-entry lastModified stamps were overwritten by

@@ -61,6 +61,16 @@ class HerderSCPDriver(SCPDriver):
         if sv.closeTime > self.app.clock.system_now() + \
                 MAX_TIME_SLIP_SECONDS:
             return ValidationLevel.INVALID
+        # every carried upgrade must be applicable — voting for a value
+        # whose upgrades we'd skip at close would fork state (ref
+        # validateValueHelper running Upgrades::isValid per upgrade)
+        from .upgrades import VALID as UPGRADE_VALID
+        from .upgrades import is_valid_for_apply
+
+        for raw_up in sv.upgrades:
+            validity, _ = is_valid_for_apply(raw_up, lcl, self.app.config)
+            if validity != UPGRADE_VALID:
+                return ValidationLevel.INVALID
         tx_set = self.herder.pending_envelopes.get_tx_set(sv.txSetHash)
         if tx_set is None:
             return ValidationLevel.MAYBE_VALID
@@ -369,7 +379,10 @@ class Herder:
             self._arm_trigger()
 
     def _pending_upgrades(self) -> List[bytes]:
-        return []
+        from .upgrades import create_upgrades_for
+
+        return create_upgrades_for(
+            self.app.ledger_manager.last_closed_header(), self.app.config)
 
     # -- externalization ---------------------------------------------------
 

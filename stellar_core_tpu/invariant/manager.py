@@ -25,6 +25,31 @@ class Invariant:
         return ""
 
 
+def entry_validity_error(entry) -> str:
+    """Structural validity of one ledger entry — shared by the tx-apply
+    and bucket-apply paths (ref LedgerEntryIsValid.cpp checks)."""
+    d = entry.data
+    if d.type == T.LedgerEntryType.ACCOUNT:
+        acc = d.value
+        if acc.balance < 0:
+            return f"account balance negative: {acc.balance}"
+        if acc.seqNum < 0:
+            return "account seqnum negative"
+        if len(acc.signers) > T.MAX_SIGNERS:
+            return "too many signers"
+    elif d.type == T.LedgerEntryType.TRUSTLINE:
+        tl = d.value
+        if tl.balance < 0 or tl.balance > tl.limit:
+            return "trustline balance out of [0, limit]"
+    elif d.type == T.LedgerEntryType.OFFER:
+        off = d.value
+        if off.amount <= 0:
+            return "offer amount non-positive"
+        if off.price.n <= 0 or off.price.d <= 0:
+            return "offer price non-positive"
+    return ""
+
+
 class LedgerEntryIsValid(Invariant):
     """Structural validity of touched entries
     (ref src/invariant/LedgerEntryIsValid.cpp)."""
@@ -33,27 +58,11 @@ class LedgerEntryIsValid(Invariant):
 
     def check_on_tx_apply(self, ltx, frame, ok: bool) -> str:
         for kb, entry in ltx._delta.items():
-            if entry is None:
-                continue
-            d = entry.data
-            if d.type == T.LedgerEntryType.ACCOUNT:
-                acc = d.value
-                if acc.balance < 0:
-                    return f"account balance negative: {acc.balance}"
-                if acc.seqNum < 0:
-                    return "account seqnum negative"
-                if len(acc.signers) > T.MAX_SIGNERS:
-                    return "too many signers"
-            elif d.type == T.LedgerEntryType.TRUSTLINE:
-                tl = d.value
-                if tl.balance < 0 or tl.balance > tl.limit:
-                    return "trustline balance out of [0, limit]"
-            elif d.type == T.LedgerEntryType.OFFER:
-                off = d.value
-                if off.amount <= 0:
-                    return "offer amount non-positive"
-                if off.price.n <= 0 or off.price.d <= 0:
-                    return "offer price non-positive"
+            if entry is None or kb.startswith(b"\xff"):
+                continue  # erased / virtual sponsorship bookkeeping
+            msg = entry_validity_error(entry)
+            if msg:
+                return msg
         return ""
 
 
@@ -240,7 +249,25 @@ class InvariantManager:
                 self.invariants.append(cls())
 
     def check_on_tx_apply(self, ltx, frame, ok: bool) -> None:
+        """Run every checker against a delta layer.  Called per
+        OPERATION from the apply loop (ref checkOnOperationApply,
+        TransactionFrame.cpp:1441); the same checkers work on any layer
+        since they only inspect the delta vs its parent."""
         for inv in self.invariants:
             msg = inv.check_on_tx_apply(ltx, frame, ok)
             if msg:
                 raise InvariantDoesNotHold(f"{inv.NAME}: {msg}")
+
+    def check_on_bucket_apply(self, entries, header) -> None:
+        """Structural validity of entries assumed from buckets during
+        catchup (ref InvariantManagerImpl::checkOnBucketApply,
+        src/invariant/InvariantManagerImpl.h:40-46 +
+        BucketListIsConsistentWithDatabase)."""
+        if not any(isinstance(i, LedgerEntryIsValid)
+                   for i in self.invariants):
+            return
+        for entry in entries:
+            msg = entry_validity_error(entry)
+            if msg:
+                raise InvariantDoesNotHold(
+                    f"LedgerEntryIsValid (bucket apply): {msg}")

@@ -169,11 +169,18 @@ class LedgerManager:
                         feeProcessing=fee_changes[i],
                         txApplyProcessing=meta))
 
-            # phase 3: upgrades (ref :786-830)
+            # phase 3: upgrades — each validated against local policy
+            # before applying; invalid remote upgrades are skipped, not
+            # fatal (ref LedgerManagerImpl :786-830 + Upgrades::
+            # isValidForApply)
+            from ..herder.upgrades import VALID, is_valid_for_apply
+
             upgrade_metas: List[object] = []
-            header_now = ltx.header()
             for raw in sv.upgrades:
-                upgrade = T.LedgerUpgrade.decode(raw)
+                validity, upgrade = is_valid_for_apply(
+                    raw, ltx.header(), self.app.config)
+                if validity != VALID:
+                    continue
                 with LedgerTxn(ltx) as ultx:
                     hdr = self._apply_upgrade(ultx.header(), upgrade)
                     ultx.set_header(hdr)

@@ -43,6 +43,9 @@ class Application:
         from ..catchup import CatchupManager
 
         self.catchup_manager = CatchupManager(self)
+        from ..process import ProcessManager
+
+        self.process_manager = ProcessManager(self)
         self._meta_stream: List = []
         self._started = False
         # real-socket mode (enable_tcp): io service + listeners
@@ -101,6 +104,7 @@ class Application:
         while self.scheduler.run_one():
             n += 1
         self.work_scheduler.crank()
+        n += self.process_manager.poll()
         if self.tcp_io is not None:
             n += self.tcp_io.poll()
         return n
@@ -132,6 +136,7 @@ class Application:
             connect_to(self, host or "127.0.0.1", int(port or 11625))
 
     def graceful_stop(self) -> None:
+        self.process_manager.shutdown()
         if self.overlay_manager is not None:
             self.overlay_manager.shutdown()
         if self.peer_door is not None:

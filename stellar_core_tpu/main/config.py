@@ -48,6 +48,17 @@ class Config:
         # to / catch up from (ref HISTORY config blocks)
         self.HISTORY_ARCHIVES: List[tuple] = kw.get("HISTORY_ARCHIVES", [])
 
+        # upgrades this node votes for when nominating (ref Upgrades::
+        # UpgradeParameters; None = don't propose)
+        self.UPGRADE_DESIRED_PROTOCOL_VERSION: Optional[int] = kw.get(
+            "UPGRADE_DESIRED_PROTOCOL_VERSION")
+        self.UPGRADE_DESIRED_BASE_FEE: Optional[int] = kw.get(
+            "UPGRADE_DESIRED_BASE_FEE")
+        self.UPGRADE_DESIRED_MAX_TX_SET_SIZE: Optional[int] = kw.get(
+            "UPGRADE_DESIRED_MAX_TX_SET_SIZE")
+        self.UPGRADE_DESIRED_BASE_RESERVE: Optional[int] = kw.get(
+            "UPGRADE_DESIRED_BASE_RESERVE")
+
         # SCP federated-tally backend: "host" (exact python), "tensor"
         # (batched device kernels, ops/quorum.py), or "both" (tensor with
         # the host oracle asserting equality — differential testing)

@@ -31,6 +31,8 @@ class CommandHandler:
             "tx": self.tx,
             "manualclose": self.manualclose,
             "ll": self.log_level,
+            "surveytopology": self.survey_topology,
+            "getsurveyresult": self.get_survey_result,
         }
 
     def handle(self, path: str, params: Dict[str, str]) -> tuple:
@@ -110,6 +112,33 @@ class CommandHandler:
             return 400, {"error": "manual close not enabled"}
         seq = self.app.herder.manual_close()
         return 200, {"ledger": seq}
+
+    def survey_topology(self, params):
+        """surveytopology?node=<hex-or-strkey> (ref CommandHandler
+        surveytopology)."""
+        om = self.app.overlay_manager
+        if om is None:
+            return 400, {"error": "no overlay"}
+        node = params.get("node", "")
+        try:
+            if node.startswith("G"):
+                from ..crypto.strkey import decode_ed25519_public_key
+
+                nid = decode_ed25519_public_key(node)
+            else:
+                nid = bytes.fromhex(node)
+        except Exception:
+            return 400, {"error": "bad node id"}
+        ok = om.survey_manager.start_survey(nid)
+        return 200, {"submitted": ok}
+
+    def get_survey_result(self, params):
+        om = self.app.overlay_manager
+        if om is None:
+            return 400, {"error": "no overlay"}
+        return 200, {"results": {
+            k.hex()[:8]: v
+            for k, v in om.survey_manager.results.items()}}
 
     def log_level(self, params):
         from ..utils import logging as L

@@ -68,12 +68,15 @@ class ItemTracker:
 
 class OverlayManager:
     def __init__(self, app):
+        from .survey import SurveyManager
+
         self.app = app
         self.pending_peers: List = []
         self.authenticated: Dict[bytes, object] = {}
         self.floodgate = Floodgate()
         self.trackers: Dict[bytes, ItemTracker] = {}
         self.banned_peers: Set[bytes] = set()
+        self.survey_manager = SurveyManager(app)
         self._shutting_down = False
 
     # -- lifecycle ---------------------------------------------------------

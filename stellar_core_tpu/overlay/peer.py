@@ -281,6 +281,10 @@ class Peer:
             om.recv_flood_advert(self, msg.value)
         elif t == MT.FLOOD_DEMAND:
             om.recv_flood_demand(self, msg.value)
+        elif t == MT.SURVEY_REQUEST:
+            om.survey_manager.relay_or_process_request(self, msg.value)
+        elif t == MT.SURVEY_RESPONSE:
+            om.survey_manager.relay_or_process_response(self, msg.value)
 
     def _recv_hello(self, hello) -> None:
         cfg = self.app.config

@@ -1,0 +1,216 @@
+"""SurveyManager: encrypted network-topology survey
+(ref src/overlay/SurveyManager.h:20-49 — relayOrProcessRequest/-Response,
+the `surveytopology` admin command).
+
+A surveyor broadcasts a signed SurveyRequestMessage naming one surveyed
+node and an ephemeral Curve25519 encryption key; nodes relay it across the
+flood network; the surveyed node encrypts its peer-stats topology to the
+surveyor's key and floods the signed response back.  Encryption here is
+X25519 ECDH -> HKDF keystream XOR + HMAC tag with the responder's
+ephemeral public key prepended (the reference uses libsodium sealed boxes;
+same shape: anonymous ephemeral -> box to recipient key)."""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from ..crypto import hkdf_expand, hmac_sha256, sha256, verify_sig
+from ..crypto.curve25519 import (
+    curve25519_derive_shared, curve25519_public, curve25519_random_secret,
+)
+from ..xdr import overlay_types as O
+from ..xdr import types as T
+
+SURVEY_THROTTLE_LEDGERS = 30  # ref: one survey per node per ~30 ledgers
+
+
+def _keystream(key: bytes, n: int) -> bytes:
+    out = b""
+    counter = 0
+    while len(out) < n:
+        out += hmac_sha256(key, counter.to_bytes(8, "big"))
+        counter += 1
+    return out[:n]
+
+
+def _seal(recipient_pub: bytes, plaintext: bytes) -> bytes:
+    eph_priv = curve25519_random_secret(os.urandom(32))
+    eph_pub = curve25519_public(eph_priv)
+    # the ephemeral side plays "caller" in the role-ordered ECDH
+    shared = curve25519_derive_shared(eph_priv, eph_pub, recipient_pub,
+                                      we_called=True)
+    key = hkdf_expand(shared, b"survey-seal", 64)
+    body = bytes(a ^ b for a, b in
+                 zip(plaintext, _keystream(key[:32], len(plaintext))))
+    tag = hmac_sha256(key[32:], eph_pub + body)
+    return eph_pub + tag + body
+
+
+def _unseal(recipient_priv: bytes, sealed: bytes) -> Optional[bytes]:
+    if len(sealed) < 64:
+        return None
+    eph_pub, tag, body = sealed[:32], sealed[32:64], sealed[64:]
+    recipient_pub = curve25519_public(recipient_priv)
+    shared = curve25519_derive_shared(recipient_priv, recipient_pub,
+                                      eph_pub, we_called=False)
+    key = hkdf_expand(shared, b"survey-seal", 64)
+    if hmac_sha256(key[32:], eph_pub + body) != tag:
+        return None
+    return bytes(a ^ b for a, b in
+                 zip(body, _keystream(key[:32], len(body))))
+
+
+class SurveyManager:
+    def __init__(self, app):
+        self.app = app
+        self._enc_priv: Optional[bytes] = None
+        self.results: Dict[bytes, dict] = {}   # surveyed id -> topology
+        self._seen: set = set()                # relay dedup
+        self._last_request_ledger: Dict[bytes, int] = {}
+
+    # -- surveyor side -------------------------------------------------------
+
+    def start_survey(self, surveyed_id: bytes) -> bool:
+        """Broadcast a survey request for one node
+        (ref SurveyManager::startSurvey)."""
+        app = self.app
+        seq = app.ledger_manager.last_closed_seq()
+        last = self._last_request_ledger.get(surveyed_id, -10**9)
+        if seq - last < SURVEY_THROTTLE_LEDGERS and last > 0:
+            return False
+        self._last_request_ledger[surveyed_id] = seq
+        if self._enc_priv is None:
+            self._enc_priv = curve25519_random_secret(os.urandom(32))
+        req = O.SurveyRequestMessage.make(
+            surveyorPeerID=T.account_id(app.config.node_id()),
+            surveyedPeerID=T.account_id(surveyed_id),
+            ledgerNum=seq,
+            encryptionKey=T.Curve25519Public.make(
+                key=curve25519_public(self._enc_priv)),
+            commandType=O.SurveyMessageCommandType.SURVEY_TOPOLOGY)
+        sig = app.config.node_secret().sign(
+            sha256(app.config.network_id() +
+                   O.SurveyRequestMessage.encode(req)))
+        signed = O.SignedSurveyRequestMessage.make(
+            requestSignature=sig, request=req)
+        self._broadcast(O.StellarMessage.make(
+            O.MessageType.SURVEY_REQUEST, signed))
+        return True
+
+    # -- relay / process (ref relayOrProcessRequest) -------------------------
+
+    def relay_or_process_request(self, peer, signed) -> None:
+        app = self.app
+        req = signed.request
+        surveyor = req.surveyorPeerID.value
+        body = sha256(app.config.network_id() +
+                      O.SurveyRequestMessage.encode(req))
+        if not verify_sig(surveyor, signed.requestSignature, body):
+            return
+        key = b"REQ" + O.SurveyRequestMessage.encode(req)
+        if key in self._seen:
+            return
+        self._remember(key)
+        msg = O.StellarMessage.make(O.MessageType.SURVEY_REQUEST, signed)
+        if req.surveyedPeerID.value != app.config.node_id():
+            self._broadcast(msg, exclude=peer)
+            return
+        # we are the surveyed node: answer with our topology
+        topo = self._topology_body()
+        sealed = _seal(req.encryptionKey.key,
+                       O.SurveyResponseBody.encode(topo))
+        resp = O.SurveyResponseMessage.make(
+            surveyorPeerID=req.surveyorPeerID,
+            surveyedPeerID=req.surveyedPeerID,
+            ledgerNum=req.ledgerNum,
+            commandType=req.commandType,
+            encryptedBody=sealed)
+        sig = app.config.node_secret().sign(
+            sha256(app.config.network_id() +
+                   O.SurveyResponseMessage.encode(resp)))
+        signed_resp = O.SignedSurveyResponseMessage.make(
+            responseSignature=sig, response=resp)
+        self._broadcast(O.StellarMessage.make(
+            O.MessageType.SURVEY_RESPONSE, signed_resp))
+
+    def relay_or_process_response(self, peer, signed) -> None:
+        app = self.app
+        resp = signed.response
+        surveyed = resp.surveyedPeerID.value
+        body = sha256(app.config.network_id() +
+                      O.SurveyResponseMessage.encode(resp))
+        if not verify_sig(surveyed, signed.responseSignature, body):
+            return
+        key = b"RSP" + sha256(O.SurveyResponseMessage.encode(resp))
+        if key in self._seen:
+            return
+        self._remember(key)
+        if resp.surveyorPeerID.value != app.config.node_id():
+            self._broadcast(O.StellarMessage.make(
+                O.MessageType.SURVEY_RESPONSE, signed), exclude=peer)
+            return
+        if self._enc_priv is None:
+            return
+        plain = _unseal(self._enc_priv, resp.encryptedBody)
+        if plain is None:
+            return
+        try:
+            topo = O.SurveyResponseBody.decode(plain)
+        except Exception:
+            return
+        v = topo.value
+        self.results[surveyed] = {
+            "inbound_peers": [p.id.value.hex()[:8]
+                              for p in v.inboundPeers],
+            "outbound_peers": [p.id.value.hex()[:8]
+                               for p in v.outboundPeers],
+            "total_inbound": v.totalInboundPeerCount,
+            "total_outbound": v.totalOutboundPeerCount,
+        }
+
+    # -- helpers -------------------------------------------------------------
+
+    MAX_SEEN = 4096
+
+    def _remember(self, key: bytes) -> None:
+        """Bounded relay-dedup memory: a spammer cycling unique signed
+        requests must not grow node memory forever (the reference clears
+        survey state on its throttle timer)."""
+        if len(self._seen) >= self.MAX_SEEN:
+            self._seen.clear()
+        self._seen.add(key)
+
+    def _topology_body(self):
+        om = self.app.overlay_manager
+        stats = []
+        if om is not None:
+            for pid, p in list(om.authenticated.items())[:25]:
+                stats.append(O.PeerStats.make(
+                    id=T.account_id(pid),
+                    versionStr=p.remote_version[:100],
+                    messagesRead=p.messages_read,
+                    messagesWritten=p.messages_written,
+                    bytesRead=p.bytes_read,
+                    bytesWritten=p.bytes_written,
+                    secondsConnected=0,
+                    uniqueFloodBytesRecv=0, duplicateFloodBytesRecv=0,
+                    uniqueFetchBytesRecv=0, duplicateFetchBytesRecv=0,
+                    uniqueFloodMessageRecv=0,
+                    duplicateFloodMessageRecv=0,
+                    uniqueFetchMessageRecv=0,
+                    duplicateFetchMessageRecv=0))
+        n = len(stats)
+        body = O.TopologyResponseBodyV1.make(
+            inboundPeers=stats, outboundPeers=[],
+            totalInboundPeerCount=n, totalOutboundPeerCount=0,
+            maxInboundPeerCount=64, maxOutboundPeerCount=8)
+        return O.SurveyResponseBody.make(
+            O.SurveyMessageResponseType.SURVEY_TOPOLOGY_RESPONSE_V1, body)
+
+    def _broadcast(self, msg, exclude=None) -> None:
+        om = self.app.overlay_manager
+        if om is None:
+            return
+        for p in list(om.authenticated.values()):
+            if p is not exclude:
+                p.send_message(msg)

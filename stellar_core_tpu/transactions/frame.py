@@ -322,11 +322,10 @@ class TransactionFrame:
               ) -> Tuple[bool, object, object]:
         """Apply operations all-or-nothing (ref apply :1752 /
         applyOperations :1388).  Returns (success, TransactionResult,
-        TransactionMeta-v2-value).  ``invariant_check(tx_ltx, frame, ok)``
-        runs against THIS tx's isolated delta before commit (ref
-        InvariantManager::checkOnOperationApply invoked from
-        TransactionFrame.cpp:1441) — scanning the whole close-level delta
-        per tx would be quadratic and misattribute violations."""
+        TransactionMeta-v2-value).  ``invariant_check(op_ltx, op_frame,
+        ok)`` runs against each OPERATION's isolated delta before its
+        commit (ref InvariantManager::checkOnOperationApply invoked from
+        TransactionFrame.cpp:1441)."""
         checker = SignatureChecker(self.full_hash(), self.signatures, verify)
         with LedgerTxn(ltx) as tx_ltx:
             res = self.common_valid(tx_ltx, apply_seq=True, charge_fee=False)
@@ -345,6 +344,12 @@ class TransactionFrame:
                 with LedgerTxn(tx_ltx) as op_ltx:
                     ok = opf.apply(op_ltx, checker)
                     if ok:
+                        # per-OPERATION invariants against this op's
+                        # isolated delta (ref InvariantManager::
+                        # checkOnOperationApply invoked from
+                        # TransactionFrame.cpp:1441)
+                        if invariant_check is not None:
+                            invariant_check(op_ltx, opf, True)
                         op_metas.append(T.OperationMeta.make(
                             changes=op_ltx.changes()))
                         op_ltx.commit()
@@ -375,8 +380,6 @@ class TransactionFrame:
                             self._make_result(TC.txBAD_SPONSORSHIP, []),
                             _empty_meta())
             if success:
-                if invariant_check is not None:
-                    invariant_check(tx_ltx, self, True)
                 tx_ltx.commit()
                 self.result_code = TC.txSUCCESS
                 # pad remaining results (loop never breaks on success)

@@ -1,0 +1,216 @@
+"""Golden tx-result/meta regression gate (component #38; modeled on the
+reference's test-tx-meta-baseline-current/ corpus, ref src/test/ +
+check-nondet).
+
+A fixed scenario suite closes ledgers; for each scenario the sha256 of the
+XDR TransactionResultSet, the concatenated tx metas, and the final ledger
+header are recorded.  The committed GOLDEN.json pins them: any change to
+apply-path semantics that alters results bit-for-bit fails here and forces
+a deliberate baseline regeneration (GOLDEN_REGEN=1 pytest ...).
+
+The reference corpus itself is keyed to the reference's own Catch2 cases
+and cannot be replayed without them; this gate applies the same
+bit-identical discipline to this framework's canonical scenarios.
+"""
+import hashlib
+import json
+import os
+
+import pytest
+
+from stellar_core_tpu.crypto import SecretKey, sha256
+from stellar_core_tpu.ledger import LedgerTxn
+from stellar_core_tpu.main import Application, test_config
+from stellar_core_tpu.transactions import liquidity_pool as LP
+from stellar_core_tpu.transactions import utils as U
+from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+from stellar_core_tpu.xdr import types as T
+
+from .txtest import TestAccount
+
+GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "golden",
+                           "GOLDEN.json")
+
+
+class NodeAccount(TestAccount):
+    def __init__(self, app, secret):
+        self.app = app
+        self.secret = secret
+        self.account_id = secret.public_key().raw
+
+    @property
+    def ledger(self):
+        class _L:
+            root_txn = self.app.ledger_manager.root
+        return _L()
+
+
+def _app():
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), test_config())
+    app.start()
+    return app
+
+
+def _digest(app, from_seq: int) -> dict:
+    """Scenario digest: results + metas + final header."""
+    rows = app.database.execute(
+        "SELECT ledgerseq, txindex, txresult, txmeta FROM txhistory "
+        "WHERE ledgerseq >= ? ORDER BY ledgerseq, txindex",
+        (from_seq,)).fetchall()
+    hres = hashlib.sha256()
+    hmeta = hashlib.sha256()
+    for _, _, res, meta in rows:
+        hres.update(res)
+        hmeta.update(meta)
+    return {
+        "results": hres.hexdigest(),
+        "metas": hmeta.hexdigest(),
+        "header": app.ledger_manager.last_closed_hash().hex(),
+        "n_txs": len(rows),
+    }
+
+
+def scenario_payments(app):
+    root = NodeAccount(app, SecretKey(app.config.network_id()))
+    a = NodeAccount(app, SecretKey(sha256(b"g-alice")))
+    b = NodeAccount(app, SecretKey(sha256(b"g-bob")))
+    seq = root.next_seq()
+    app.herder.recv_transaction(root.tx(
+        [root.op_create_account(a.account_id, 10**10)], seq=seq))
+    app.herder.recv_transaction(root.tx(
+        [root.op_create_account(b.account_id, 10**10)], seq=seq + 1))
+    app.herder.manual_close()
+    app.herder.recv_transaction(a.tx([a.op_payment(b.account_id, 10**7)]))
+    app.herder.recv_transaction(b.tx([b.op_payment(a.account_id, 3)]))
+    app.herder.manual_close()
+    # a failing payment (underfunded) is part of the baseline too
+    app.herder.recv_transaction(a.tx(
+        [a.op_payment(b.account_id, 10**17)]))
+    app.herder.manual_close()
+
+
+def scenario_trust_and_dex(app):
+    root = NodeAccount(app, SecretKey(app.config.network_id()))
+    issuer = NodeAccount(app, SecretKey(sha256(b"g-issuer")))
+    m1 = NodeAccount(app, SecretKey(sha256(b"g-m1")))
+    m2 = NodeAccount(app, SecretKey(sha256(b"g-m2")))
+    seq = root.next_seq()
+    for i, acc in enumerate((issuer, m1, m2)):
+        app.herder.recv_transaction(root.tx(
+            [root.op_create_account(acc.account_id, 10**10)], seq=seq + i))
+    app.herder.manual_close()
+    usd = U.make_asset(b"USD", issuer.account_id)
+    app.herder.recv_transaction(m1.tx([m1.op_change_trust(usd)]))
+    app.herder.recv_transaction(m2.tx([m2.op_change_trust(usd)]))
+    app.herder.manual_close()
+    app.herder.recv_transaction(issuer.tx(
+        [issuer.op_payment(m1.account_id, 10**9, usd)]))
+    app.herder.manual_close()
+    # cross an offer: m1 sells USD for XLM, m2 buys
+    sell = m1.op(T.OperationType.MANAGE_SELL_OFFER,
+                 T.ManageSellOfferOp.make(
+                     selling=usd, buying=U.asset_native(),
+                     amount=10**6, price=T.Price.make(n=2, d=1),
+                     offerID=0))
+    app.herder.recv_transaction(m1.tx([sell]))
+    app.herder.manual_close()
+    buy = m2.op(T.OperationType.MANAGE_SELL_OFFER,
+                T.ManageSellOfferOp.make(
+                    selling=U.asset_native(), buying=usd,
+                    amount=3 * 10**6, price=T.Price.make(n=1, d=2),
+                    offerID=0))
+    app.herder.recv_transaction(m2.tx([buy]))
+    app.herder.manual_close()
+
+
+def scenario_sponsorship_cb_pool(app):
+    root = NodeAccount(app, SecretKey(app.config.network_id()))
+    sp = NodeAccount(app, SecretKey(sha256(b"g-sponsor")))
+    issuer = NodeAccount(app, SecretKey(sha256(b"g-poolissuer")))
+    a = NodeAccount(app, SecretKey(sha256(b"g-pool-a")))
+    seq = root.next_seq()
+    for i, acc in enumerate((sp, issuer, a)):
+        app.herder.recv_transaction(root.tx(
+            [root.op_create_account(acc.account_id, 10**10)], seq=seq + i))
+    app.herder.manual_close()
+    # sponsored zero-balance account
+    newbie = NodeAccount(app, SecretKey(sha256(b"g-newbie")))
+    env = sp.tx([
+        sp.op_begin_sponsoring(newbie.account_id),
+        sp.op_create_account(newbie.account_id, 0),
+        sp.op_end_sponsoring(source=newbie.account_id),
+    ], extra_signers=[newbie.secret])
+    app.herder.recv_transaction(env)
+    app.herder.manual_close()
+    # claimable balance lifecycle
+    env = a.tx([a.op_create_claimable_balance(
+        U.asset_native(), 5 * 10**6, [(sp.account_id, None)])])
+    app.herder.recv_transaction(env)
+    app.herder.manual_close()
+    row = app.database.execute(
+        "SELECT txresult FROM txhistory WHERE ledgerseq=?",
+        (app.ledger_manager.last_closed_seq(),)).fetchone()
+    bid = T.TransactionResultPair.decode(
+        row[0]).result.result.value[0].value.value.value
+    app.herder.recv_transaction(sp.tx([sp.op_claim_claimable_balance(bid)]))
+    app.herder.manual_close()
+    # pool lifecycle + fee bump
+    usd = U.make_asset(b"PUSD", issuer.account_id)
+    app.herder.recv_transaction(a.tx([a.op_change_trust(usd)]))
+    app.herder.manual_close()
+    app.herder.recv_transaction(issuer.tx(
+        [issuer.op_payment(a.account_id, 10**9, usd)]))
+    app.herder.manual_close()
+    app.herder.recv_transaction(a.tx(
+        [a.op_change_trust_pool(U.asset_native(), usd)]))
+    app.herder.manual_close()
+    params = T.LiquidityPoolParameters.make(
+        T.LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT,
+        T.LiquidityPoolConstantProductParameters.make(
+            assetA=U.asset_native(), assetB=usd,
+            fee=T.LIQUIDITY_POOL_FEE_V18))
+    pool_id = LP.pool_id_from_params(params)
+    app.herder.recv_transaction(a.tx(
+        [a.op_pool_deposit(pool_id, 4 * 10**6, 10**6)]))
+    app.herder.manual_close()
+    inner = a.tx([a.op_payment(sp.account_id, 1234)])
+    app.herder.recv_transaction(sp.fee_bump(inner, fee_source=sp))
+    app.herder.manual_close()
+
+
+SCENARIOS = {
+    "payments": scenario_payments,
+    "trust_and_dex": scenario_trust_and_dex,
+    "sponsorship_cb_pool_feebump": scenario_sponsorship_cb_pool,
+}
+
+
+def _compute_all() -> dict:
+    out = {}
+    for name, fn in SCENARIOS.items():
+        app = _app()
+        fn(app)
+        out[name] = _digest(app, from_seq=2)
+    return out
+
+
+def test_golden_baseline():
+    computed = _compute_all()
+    if os.environ.get("GOLDEN_REGEN") == "1":
+        os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+        with open(GOLDEN_PATH, "w") as f:
+            json.dump(computed, f, indent=1, sort_keys=True)
+        pytest.skip("baseline regenerated")
+    if not os.path.exists(GOLDEN_PATH):
+        pytest.fail("GOLDEN.json missing — run with GOLDEN_REGEN=1")
+    with open(GOLDEN_PATH) as f:
+        golden = json.load(f)
+    assert computed == golden, (
+        "tx results/meta diverged from the golden baseline; if the change "
+        "is intentional, regenerate with GOLDEN_REGEN=1")
+
+
+def test_baseline_is_deterministic():
+    """Two independent runs must produce identical digests (the
+    check-nondet discipline)."""
+    assert _compute_all() == _compute_all()

@@ -1,0 +1,104 @@
+"""SurveyManager over a relayed topology + ProcessManager
+(ref src/overlay/SurveyManager.h, src/process/ProcessManagerImpl.cpp)."""
+import os
+
+from stellar_core_tpu.process import ProcessManager, RunCommandWork
+from stellar_core_tpu.simulation.simulation import Simulation, _ids, _seeds
+from stellar_core_tpu.work.work import State
+
+
+def _line_sim(n=3):
+    """A -- B -- C line: surveys from A to C must relay through B."""
+    sim = Simulation(network_passphrase="survey net")
+    seeds = _seeds(n)
+    ids = _ids(seeds)
+    qset = {"threshold": 2, "validators": ids}
+    for s in seeds:
+        sim.add_node(s, qset)
+    for i in range(n - 1):
+        sim.add_connection(ids[i], ids[i + 1])
+    return sim, ids
+
+
+class TestSurvey:
+    def test_survey_relays_and_returns_topology(self):
+        sim, ids = _line_sim()
+        sim.start_all_nodes()
+        sim.crank_for(2.0)
+        a = sim.nodes[ids[0]]
+        c = sim.nodes[ids[2]]
+        sm = a.overlay_manager.survey_manager
+        assert sm.start_survey(ids[2])
+        sim.crank_for(3.0)
+        assert ids[2] in sm.results, "survey response never arrived"
+        topo = sm.results[ids[2]]
+        # C has exactly one authenticated peer (B)
+        assert topo["total_inbound"] == 1
+        assert topo["inbound_peers"] == [ids[1].hex()[:8]]
+
+    def test_survey_throttled(self):
+        sim, ids = _line_sim()
+        sim.start_all_nodes()
+        sim.crank_for(1.0)
+        sm = sim.nodes[ids[0]].overlay_manager.survey_manager
+        assert sm.start_survey(ids[2])
+        assert not sm.start_survey(ids[2])  # throttled
+
+    def test_tampered_request_dropped(self):
+        sim, ids = _line_sim()
+        sim.start_all_nodes()
+        sim.crank_for(1.0)
+        from stellar_core_tpu.xdr import overlay_types as O
+        from stellar_core_tpu.xdr import types as T
+
+        b = sim.nodes[ids[1]]
+        sm_b = b.overlay_manager.survey_manager
+        req = O.SurveyRequestMessage.make(
+            surveyorPeerID=T.account_id(ids[0]),
+            surveyedPeerID=T.account_id(ids[1]),
+            ledgerNum=1,
+            encryptionKey=T.Curve25519Public.make(key=b"\x05" * 32),
+            commandType=O.SurveyMessageCommandType.SURVEY_TOPOLOGY)
+        forged = O.SignedSurveyRequestMessage.make(
+            requestSignature=b"\x00" * 64, request=req)
+        before = len(sm_b._seen)
+        sm_b.relay_or_process_request(None, forged)
+        assert len(sm_b._seen) == before  # bad signature: ignored
+
+
+class TestProcessManager:
+    def test_run_and_reap(self, tmp_path):
+        pm = ProcessManager()
+        marker = tmp_path / "touched"
+        exits = []
+        pm.run_command(f"touch {marker}", exits.append)
+        pm.wait_all()
+        assert exits and exits[0].ok
+        assert marker.exists()
+
+    def test_failure_status(self):
+        pm = ProcessManager()
+        exits = []
+        pm.run_command("false", exits.append)
+        pm.wait_all()
+        assert exits and not exits[0].ok
+
+    def test_concurrency_cap(self, tmp_path):
+        pm = ProcessManager(max_concurrent=2)
+        for i in range(6):
+            pm.run_command(f"touch {tmp_path}/f{i}")
+        assert len(pm.running) <= 2
+        pm.wait_all()
+        assert pm.total_spawned == 6
+        assert len(os.listdir(tmp_path)) == 6
+
+    def test_run_command_work(self, tmp_path):
+        pm = ProcessManager()
+        w = RunCommandWork(pm, f"touch {tmp_path}/via-work")
+        w.start()
+        for _ in range(10000):
+            w.crank()
+            if w.state not in (State.RUNNING, State.WAITING):
+                break
+        assert w.state == State.SUCCESS
+        assert (tmp_path / "via-work").exists()

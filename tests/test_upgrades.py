@@ -1,0 +1,96 @@
+"""Upgrade validation + voting (ref src/herder/Upgrades.cpp
+isValidForApply :511, createUpgradesFor :79; test model
+src/herder/test/UpgradesTests.cpp)."""
+from stellar_core_tpu.crypto import SecretKey, sha256
+from stellar_core_tpu.herder.upgrades import (
+    INVALID, VALID, XDR_INVALID, create_upgrades_for, is_valid_for_apply,
+)
+from stellar_core_tpu.main import Application, test_config
+from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+from stellar_core_tpu.xdr import types as T
+
+UT = T.LedgerUpgradeType
+
+
+def raw(t, v):
+    return T.LedgerUpgrade.encode(T.LedgerUpgrade.make(t, v))
+
+
+def header(version=19, base_fee=100, reserve=5000000):
+    from .txtest import genesis_header
+
+    h = genesis_header()
+    return h._replace(ledgerVersion=version, baseFee=base_fee,
+                      baseReserve=reserve)
+
+
+class TestIsValidForApply:
+    def test_version_must_be_monotonic_and_supported(self):
+        cfg = test_config()
+        h = header(version=18)
+        assert is_valid_for_apply(raw(UT.LEDGER_UPGRADE_VERSION, 19),
+                                  h, cfg)[0] == VALID
+        assert is_valid_for_apply(raw(UT.LEDGER_UPGRADE_VERSION, 18),
+                                  h, cfg)[0] == INVALID  # not monotonic
+        assert is_valid_for_apply(raw(UT.LEDGER_UPGRADE_VERSION, 25),
+                                  h, cfg)[0] == INVALID  # unsupported
+
+    def test_zero_fee_and_reserve_rejected(self):
+        cfg = test_config()
+        h = header()
+        assert is_valid_for_apply(raw(UT.LEDGER_UPGRADE_BASE_FEE, 0),
+                                  h, cfg)[0] == INVALID
+        assert is_valid_for_apply(raw(UT.LEDGER_UPGRADE_BASE_RESERVE, 0),
+                                  h, cfg)[0] == INVALID
+        assert is_valid_for_apply(raw(UT.LEDGER_UPGRADE_BASE_FEE, 200),
+                                  h, cfg)[0] == VALID
+
+    def test_flags_mask(self):
+        cfg = test_config()
+        h = header()
+        assert is_valid_for_apply(raw(UT.LEDGER_UPGRADE_FLAGS, 0x7),
+                                  h, cfg)[0] == VALID
+        assert is_valid_for_apply(raw(UT.LEDGER_UPGRADE_FLAGS, 0x8),
+                                  h, cfg)[0] == INVALID
+
+    def test_garbage_is_xdr_invalid(self):
+        cfg = test_config()
+        assert is_valid_for_apply(b"\xff\xff\xff", header(),
+                                  cfg)[0] == XDR_INVALID
+
+
+class TestVotingAndApply:
+    def test_configured_upgrade_applies_through_consensus(self):
+        cfg = test_config(UPGRADE_DESIRED_BASE_FEE=250)
+        app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+        app.start()
+        assert app.ledger_manager.last_closed_header().baseFee == 100
+        app.herder.manual_close()
+        assert app.ledger_manager.last_closed_header().baseFee == 250
+        # once applied, the node stops proposing it
+        ups = create_upgrades_for(
+            app.ledger_manager.last_closed_header(), cfg)
+        assert ups == []
+
+    def test_invalid_remote_upgrade_skipped(self):
+        """A zero base-fee upgrade in an externalized value is skipped;
+        the close succeeds and the fee is unchanged."""
+        app = Application(VirtualClock(ClockMode.VIRTUAL_TIME),
+                          test_config())
+        app.start()
+        from stellar_core_tpu.herder.tx_set import TxSetFrame
+        from stellar_core_tpu.ledger.ledger_manager import LedgerCloseData
+
+        lm = app.ledger_manager
+        ts = TxSetFrame(app.config.network_id(), lm.last_closed_hash(), [])
+        sv = T.StellarValue.make(
+            txSetHash=ts.contents_hash(),
+            closeTime=lm.last_closed_header().scpValue.closeTime + 1,
+            upgrades=[raw(UT.LEDGER_UPGRADE_BASE_FEE, 0),
+                      raw(UT.LEDGER_UPGRADE_MAX_TX_SET_SIZE, 500)],
+            ext=T.StellarValue.fields[3][1].make(
+                T.StellarValueType.STELLAR_VALUE_BASIC))
+        lm.close_ledger(LedgerCloseData(2, ts, sv))
+        hdr = lm.last_closed_header()
+        assert hdr.baseFee == 100          # invalid upgrade skipped
+        assert hdr.maxTxSetSize == 500     # valid one applied
