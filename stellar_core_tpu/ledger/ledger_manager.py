@@ -112,7 +112,11 @@ class LedgerManager:
 
     def close_ledger(self, close_data: LedgerCloseData) -> None:
         """ref closeLedger :669-933."""
-        with self.metrics.timer("ledger.ledger.close").time_scope():
+        from ..utils.logging import LogSlowExecution
+
+        with self.metrics.timer("ledger.ledger.close").time_scope(), \
+                LogSlowExecution(f"closeLedger {close_data.ledger_seq}",
+                                 threshold_seconds=2.0):
             self._close_ledger_inner(close_data)
 
     def _close_ledger_inner(self, close_data: LedgerCloseData) -> None:

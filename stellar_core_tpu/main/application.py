@@ -14,7 +14,6 @@ from ..bucket.bucket_list import BucketManager
 from ..herder.herder import Herder
 from ..invariant.manager import InvariantManager
 from ..ledger.ledger_manager import LedgerManager
-from ..ledger.ledger_txn import open_database
 from ..utils.clock import ClockMode, VirtualClock
 from ..utils.metrics import MetricsRegistry
 from ..utils.scheduler import Scheduler
@@ -29,7 +28,9 @@ class Application:
         self.config = config
         self.metrics = MetricsRegistry(clock)
         self.scheduler = Scheduler(clock)
-        self.database = open_database(config.DATABASE)
+        from ..database import Database
+
+        self.database = Database(config.DATABASE, metrics=self.metrics)
         self.bucket_manager = BucketManager(
             self, bucket_dir=getattr(config, "BUCKET_DIR_PATH_REAL", None))
         self.invariants = InvariantManager(config.INVARIANT_CHECKS)

@@ -1,0 +1,115 @@
+// Native bucket-merge kernel: the sorted two-way merge with
+// INIT/LIVE/DEAD shadowing semantics over serialized bucket entries
+// (ref src/bucket/Bucket.cpp merge logic + BucketOutputIterator — the
+// reference's background worker compute; SURVEY.md §2.7).
+//
+// The Python tier passes two entry tables as flat arrays:
+//   keys:    concatenated key bytes
+//   k_off/k_len: per-entry key slices (int64/int32)
+//   types:   per-entry BucketEntryType (0=LIVE,1=DEAD,2=INIT per
+//            protocol-11+ semantics, matching xdr types)
+// and receives, for each surviving output slot, the source side
+// (0=newer, 1=older), the source index, and a result type override
+// (-1 = keep source entry unchanged; else re-tag to this type, which
+// Python applies by rebuilding the entry with the same value).
+//
+// Merge-case table mirrors stellar_core_tpu/bucket/bucket_list.py
+// _merge_entry (itself re-derived from Bucket::mergeCasesWithEqualKeys):
+//   DEAD over INIT              -> annihilate
+//   LIVE/INIT over INIT         -> INIT with newer value
+//   INIT over DEAD              -> LIVE with newer value
+//   otherwise                   -> newer entry unchanged
+//
+// Build: g++ -O2 -shared -fPIC -o _native.so bucket_merge.cpp
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// lexicographic compare of two byte slices
+int cmp_keys(const uint8_t* a, int32_t alen, const uint8_t* b,
+             int32_t blen) {
+  int32_t n = alen < blen ? alen : blen;
+  int c = std::memcmp(a, b, static_cast<size_t>(n));
+  if (c != 0) return c;
+  if (alen == blen) return 0;
+  return alen < blen ? -1 : 1;
+}
+
+constexpr int32_t kLive = 0;
+constexpr int32_t kDead = 1;
+constexpr int32_t kInit = 2;
+
+}  // namespace
+
+extern "C" {
+
+// Returns the number of output entries written to out_side/out_idx/
+// out_type (capacity must be >= n_new + n_old).
+int64_t bucket_merge(
+    const uint8_t* new_keys, const int64_t* new_off,
+    const int32_t* new_len, const int32_t* new_types, int64_t n_new,
+    const uint8_t* old_keys, const int64_t* old_off,
+    const int32_t* old_len, const int32_t* old_types, int64_t n_old,
+    int32_t* out_side, int64_t* out_idx, int32_t* out_type) {
+  int64_t i = 0, j = 0, w = 0;
+  while (i < n_new && j < n_old) {
+    int c = cmp_keys(new_keys + new_off[i], new_len[i],
+                     old_keys + old_off[j], old_len[j]);
+    if (c < 0) {
+      out_side[w] = 0; out_idx[w] = i; out_type[w] = -1;
+      ++w; ++i;
+    } else if (c > 0) {
+      out_side[w] = 1; out_idx[w] = j; out_type[w] = -1;
+      ++w; ++j;
+    } else {
+      int32_t nt = new_types[i];
+      int32_t ot = old_types[j];
+      if (nt == kDead && ot == kInit) {
+        // annihilate: entry never existed at this level
+      } else if ((nt == kLive || nt == kInit) && ot == kInit) {
+        out_side[w] = 0; out_idx[w] = i; out_type[w] = kInit; ++w;
+      } else if (nt == kInit && ot == kDead) {
+        out_side[w] = 0; out_idx[w] = i; out_type[w] = kLive; ++w;
+      } else {
+        out_side[w] = 0; out_idx[w] = i; out_type[w] = -1; ++w;
+      }
+      ++i; ++j;
+    }
+  }
+  for (; i < n_new; ++i) {
+    out_side[w] = 0; out_idx[w] = i; out_type[w] = -1; ++w;
+  }
+  for (; j < n_old; ++j) {
+    out_side[w] = 1; out_idx[w] = j; out_type[w] = -1; ++w;
+  }
+  return w;
+}
+
+// Batched lexicographic lower_bound over a sorted key table — the
+// BucketIndex point-lookup core (ref src/bucket/BucketIndexImpl.cpp).
+// Writes, per probe, the index of the first key >= probe (or n_keys).
+void bucket_lower_bound(
+    const uint8_t* keys, const int64_t* k_off, const int32_t* k_len,
+    int64_t n_keys,
+    const uint8_t* probes, const int64_t* p_off, const int32_t* p_len,
+    int64_t n_probes, int64_t* out_pos) {
+  for (int64_t p = 0; p < n_probes; ++p) {
+    int64_t lo = 0, hi = n_keys;
+    const uint8_t* pk = probes + p_off[p];
+    int32_t pl = p_len[p];
+    while (lo < hi) {
+      int64_t mid = lo + (hi - lo) / 2;
+      int c = cmp_keys(keys + k_off[mid], k_len[mid], pk, pl);
+      if (c < 0) {
+        lo = mid + 1;
+      } else {
+        hi = mid;
+      }
+    }
+    out_pos[p] = lo;
+  }
+}
+
+}  // extern "C"

@@ -376,3 +376,105 @@ def _delete_offer(ltx, entry) -> None:
         acc = owner.data.value
         put_account(ltx, owner, acc._replace(
             numSubEntries=max(0, acc.numSubEntries - 1)))
+
+
+# ---------------------------------------------------------------------------
+# pools in the path (ref convertWithOffersAndPools :316 + exchangeWithPool
+# :1242 + shouldConvertWithOffers :1617)
+# ---------------------------------------------------------------------------
+
+def _pool_exchange_quote(ltx, sheep, wheat, max_sheep_send: int,
+                         max_wheat_receive: int, round_: RoundingType):
+    """(to_pool, from_pool, pool_entry, cp, sheep_is_a) or None if the
+    pool can't do this exchange (absent, depleted, overflow, zero out)."""
+    from . import liquidity_pool as LP
+
+    sheep_is_a = LP.compare_assets(sheep, wheat) < 0
+    a, b = (sheep, wheat) if sheep_is_a else (wheat, sheep)
+    params = T.LiquidityPoolParameters.make(
+        T.LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT,
+        T.LiquidityPoolConstantProductParameters.make(
+            assetA=a, assetB=b, fee=T.LIQUIDITY_POOL_FEE_V18))
+    pool_id = LP.pool_id_from_params(params)
+    pool_entry = LP.load_pool(ltx, pool_id)
+    if pool_entry is None:
+        return None
+    cp = LP.constant_product(pool_entry)
+    reserves_in = cp.reserveA if sheep_is_a else cp.reserveB
+    reserves_out = cp.reserveB if sheep_is_a else cp.reserveA
+    if reserves_in <= 0 or reserves_out <= 0:
+        return None
+    fee = cp.params.fee
+    if round_ == RoundingType.PATH_PAYMENT_STRICT_SEND:
+        to_pool = max_sheep_send
+        from_pool = LP.swap_out_given_in(reserves_in, reserves_out,
+                                         to_pool, fee)
+        if from_pool is None:
+            return None
+    elif round_ == RoundingType.PATH_PAYMENT_STRICT_RECEIVE:
+        from_pool = max_wheat_receive
+        to_pool = LP.swap_in_given_out(reserves_in, reserves_out,
+                                       from_pool, fee)
+        if to_pool is None:
+            return None
+    else:
+        return None  # pools only participate in path payments
+    return to_pool, from_pool, pool_entry, cp, sheep_is_a
+
+
+def convert_with_offers_and_pools(
+    ltx, header, source_id: bytes,
+    sheep, max_sheep_send: int,
+    wheat, max_wheat_receive: int,
+    round_: RoundingType,
+    price_filter: Optional[Callable] = None,
+) -> Tuple[ConvertResult, int, int, List[object]]:
+    """One path-payment hop: use the liquidity pool unless the order book
+    gives a strictly better price (ref convertWithOffersAndPools +
+    shouldConvertWithOffers — 'use the pool unless the book is strictly
+    better').
+
+    The book attempt runs in a child LedgerTxn that commits only when the
+    book wins; the pool exchange mutates the pool reserves and yields one
+    CLAIM_ATOM_TYPE_LIQUIDITY_POOL atom."""
+    from ..ledger.ledger_txn import LedgerTxn
+    from . import liquidity_pool as LP
+
+    quote = _pool_exchange_quote(ltx, sheep, wheat, max_sheep_send,
+                                 max_wheat_receive, round_)
+
+    with LedgerTxn(ltx) as book_ltx:
+        result, sheep_sent, wheat_recv, atoms = convert_with_offers(
+            book_ltx, header, source_id, sheep, max_sheep_send,
+            wheat, max_wheat_receive, round_, price_filter)
+        use_book = True
+        if quote is not None:
+            to_pool, from_pool, _, _, _ = quote
+            if result != ConvertResult.OK:
+                use_book = False
+            else:
+                # book wins only at a strictly better price:
+                # poolSend * bookRecv > poolRecv * bookSend
+                use_book = (to_pool * wheat_recv >
+                            from_pool * sheep_sent)
+        if use_book:
+            book_ltx.commit()
+            return result, sheep_sent, wheat_recv, atoms
+        book_ltx.rollback()
+
+    # pool path: apply the swap to the reserves
+    to_pool, from_pool, pool_entry, cp, sheep_is_a = quote
+    if sheep_is_a:
+        cp = cp._replace(reserveA=cp.reserveA + to_pool,
+                         reserveB=cp.reserveB - from_pool)
+    else:
+        cp = cp._replace(reserveB=cp.reserveB + to_pool,
+                         reserveA=cp.reserveA - from_pool)
+    ltx.put(LP.pool_with_cp(pool_entry, cp))
+    atom = T.ClaimAtom.make(
+        T.ClaimAtomType.CLAIM_ATOM_TYPE_LIQUIDITY_POOL,
+        T.ClaimLiquidityAtom.make(
+            liquidityPoolID=pool_entry.data.value.liquidityPoolID,
+            assetSold=wheat, amountSold=from_pool,
+            assetBought=sheep, amountBought=to_pool))
+    return ConvertResult.OK, to_pool, from_pool, [atom]

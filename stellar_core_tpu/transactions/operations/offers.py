@@ -12,6 +12,7 @@ from .. import utils as U
 from ..offer_exchange import (
     ConvertResult, ExchangeError, INT64_MAX, RoundingType, big_divide,
     can_buy_at_most, can_sell_at_most, convert_with_offers,
+    convert_with_offers_and_pools,
     offer_buying_liabilities, _credit,
 )
 from .base import OperationFrame, op_inner, put_account
@@ -375,9 +376,18 @@ class PathPaymentStrictReceiveOpFrame(OperationFrame):
             selling = chain[i - 1]
             if U.assets_equal(buying, selling):
                 continue
-            result, sheep_sent, wheat_recv, atoms = convert_with_offers(
-                ltx, header, src_id, selling, INT64_MAX, buying, need,
-                RoundingType.PATH_PAYMENT_STRICT_RECEIVE)
+            result, sheep_sent, wheat_recv, atoms = \
+                convert_with_offers_and_pools(
+                    ltx, header, src_id, selling, INT64_MAX, buying, need,
+                    RoundingType.PATH_PAYMENT_STRICT_RECEIVE)
+            if result == ConvertResult.CROSSED_SELF:
+                return self._res(
+                    C.PATH_PAYMENT_STRICT_RECEIVE_OFFER_CROSS_SELF)
+            if result == ConvertResult.TOO_MANY_OFFERS:
+                from .base import op_error
+
+                return op_error(
+                    T.OperationResultCode.opEXCEEDED_WORK_LIMIT)
             if wheat_recv < need:
                 return self._res(
                     C.PATH_PAYMENT_STRICT_RECEIVE_TOO_FEW_OFFERS)
@@ -466,9 +476,18 @@ class PathPaymentStrictSendOpFrame(OperationFrame):
             buying = chain[i + 1]
             if U.assets_equal(selling, buying):
                 continue
-            result, sheep_sent, wheat_recv, atoms = convert_with_offers(
-                ltx, header, src_id, selling, have, buying, INT64_MAX,
-                RoundingType.PATH_PAYMENT_STRICT_SEND)
+            result, sheep_sent, wheat_recv, atoms = \
+                convert_with_offers_and_pools(
+                    ltx, header, src_id, selling, have, buying, INT64_MAX,
+                    RoundingType.PATH_PAYMENT_STRICT_SEND)
+            if result == ConvertResult.CROSSED_SELF:
+                return self._res(
+                    C.PATH_PAYMENT_STRICT_SEND_OFFER_CROSS_SELF)
+            if result == ConvertResult.TOO_MANY_OFFERS:
+                from .base import op_error
+
+                return op_error(
+                    T.OperationResultCode.opEXCEEDED_WORK_LIMIT)
             if sheep_sent < have:
                 return self._res(C.PATH_PAYMENT_STRICT_SEND_TOO_FEW_OFFERS)
             all_atoms.extend(atoms)

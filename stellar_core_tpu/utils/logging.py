@@ -47,3 +47,31 @@ def init(level: str = "INFO") -> None:
     logging.basicConfig(
         format="%(asctime)s %(name)s [%(levelname)s] %(message)s")
     set_log_level(level)
+
+
+class LogSlowExecution:
+    """Scope timer that logs when a step exceeds a threshold
+    (ref src/util/LogSlowExecution.h — used around closeLedger,
+    LedgerManagerImpl.cpp:673)."""
+
+    def __init__(self, name: str, threshold_seconds: float = 1.0,
+                 partition: str = "Perf"):
+        import time as _time
+
+        self.name = name
+        self.threshold = threshold_seconds
+        self.partition = partition
+        self._time = _time
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = self._time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = self._time.perf_counter() - self._t0
+        if dt > self.threshold:
+            get_logger(self.partition).warning(
+                "slow execution: %s took %.3fs (threshold %.3fs)",
+                self.name, dt, self.threshold)
+        return False

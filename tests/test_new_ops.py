@@ -471,3 +471,103 @@ class TestFeeBump:
             res = frame.check_valid(ltx)
             ltx.rollback()
         assert res.ok
+
+
+# ---------------------------------------------------------------------------
+# pool path payments (convertWithOffersAndPools)
+# ---------------------------------------------------------------------------
+
+class TestPoolPathPayment:
+    def _setup(self, ledger):
+        root = ledger.root()
+        issuer = root.create("ppp-issuer", 10**11)
+        lp = root.create("ppp-lp", 10**11)
+        src = root.create("ppp-src", 10**11)
+        dst = root.create("ppp-dst", 10**11)
+        usd = U.make_asset(b"USD", issuer.account_id)
+        for acc in (lp, src, dst):
+            acc.apply(acc.tx([acc.op_change_trust(usd)]))
+        issuer.apply(issuer.tx([issuer.op_payment(lp.account_id, 10**10,
+                                                  usd)]))
+        xlm = U.asset_native()
+        lp.apply(lp.tx([lp.op_change_trust_pool(xlm, usd)]))
+        params = T.LiquidityPoolParameters.make(
+            T.LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT,
+            T.LiquidityPoolConstantProductParameters.make(
+                assetA=xlm, assetB=usd, fee=T.LIQUIDITY_POOL_FEE_V18))
+        pool_id = LP.pool_id_from_params(params)
+        # pool at 1 XLM : 1 USD with deep reserves
+        lp.apply(lp.tx([lp.op_pool_deposit(pool_id, 10**9, 10**9)]))
+        return root, issuer, lp, src, dst, usd, pool_id
+
+    def _pp_strict_send(self, acc, dest, send_asset, send_amount,
+                        dest_asset, dest_min, path=()):
+        return acc.op(T.OperationType.PATH_PAYMENT_STRICT_SEND,
+                      T.PathPaymentStrictSendOp.make(
+                          sendAsset=send_asset, sendAmount=send_amount,
+                          destination=T.muxed_account(dest),
+                          destAsset=dest_asset, destMin=dest_min,
+                          path=list(path)))
+
+    def test_empty_book_routes_through_pool(self, ledger):
+        root, issuer, lp, src, dst, usd, pool_id = self._setup(ledger)
+        ok, res = src.apply(src.tx([self._pp_strict_send(
+            src, dst.account_id, U.asset_native(), 10**6, usd, 1)]))
+        success = res.result.value[0].value.value
+        atoms = success.value.offers
+        assert len(atoms) == 1
+        assert atoms[0].type == T.ClaimAtomType.CLAIM_ATOM_TYPE_LIQUIDITY_POOL
+        la = atoms[0].value
+        assert la.amountBought == 10**6          # XLM into the pool
+        # ~0.3% fee on a deep 1:1 pool
+        assert 0.996 * 10**6 < la.amountSold <= 10**6
+        # pool reserves moved
+        pool = src.entry(LP.pool_key(pool_id))
+        cp = LP.constant_product(pool)
+        assert cp.reserveA == 10**9 + 10**6
+        assert cp.reserveB == 10**9 - la.amountSold
+        # destination got the USD
+        tl = dst.entry(trustline_key(dst.account_id, usd))
+        assert tl.data.value.balance == la.amountSold
+
+    def test_better_book_price_beats_pool(self, ledger):
+        root, issuer, lp, src, dst, usd, pool_id = self._setup(ledger)
+        # a seller offering USD at a better-than-pool price (1 USD per
+        # 0.5 XLM => the taker gets 2 USD per XLM, pool gives ~1)
+        seller = root.create("ppp-seller", 10**11)
+        seller.apply(seller.tx([seller.op_change_trust(usd)]))
+        issuer.apply(issuer.tx([issuer.op_payment(
+            seller.account_id, 10**9, usd)]))
+        sell = seller.op(T.OperationType.MANAGE_SELL_OFFER,
+                         T.ManageSellOfferOp.make(
+                             selling=usd, buying=U.asset_native(),
+                             amount=10**8,
+                             price=T.Price.make(n=1, d=2), offerID=0))
+        seller.apply(seller.tx([sell]))
+        ok, res = src.apply(src.tx([self._pp_strict_send(
+            src, dst.account_id, U.asset_native(), 10**6, usd, 1)]))
+        atoms = res.result.value[0].value.value.value.offers
+        assert len(atoms) == 1
+        assert atoms[0].type == T.ClaimAtomType.CLAIM_ATOM_TYPE_ORDER_BOOK
+        assert atoms[0].value.amountSold == 2 * 10**6  # 2 USD per XLM
+        # pool untouched
+        cp = LP.constant_product(src.entry(LP.pool_key(pool_id)))
+        assert cp.reserveA == 10**9 and cp.reserveB == 10**9
+
+    def test_strict_receive_through_pool(self, ledger):
+        root, issuer, lp, src, dst, usd, pool_id = self._setup(ledger)
+        op = src.op(T.OperationType.PATH_PAYMENT_STRICT_RECEIVE,
+                    T.PathPaymentStrictReceiveOp.make(
+                        sendAsset=U.asset_native(), sendMax=2 * 10**6,
+                        destination=T.muxed_account(dst.account_id),
+                        destAsset=usd, destAmount=10**6, path=[]))
+        ok, res = src.apply(src.tx([op]))
+        atoms = res.result.value[0].value.value.value.offers
+        assert len(atoms) == 1
+        assert atoms[0].type == T.ClaimAtomType.CLAIM_ATOM_TYPE_LIQUIDITY_POOL
+        la = atoms[0].value
+        assert la.amountSold == 10**6            # exact USD out
+        assert 10**6 < la.amountBought < 1.005 * 10**6  # XLM in + fee
+        # pool invariant k did not decrease
+        cp = LP.constant_product(src.entry(LP.pool_key(pool_id)))
+        assert cp.reserveA * cp.reserveB >= 10**18

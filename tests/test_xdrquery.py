@@ -1,0 +1,60 @@
+"""xdrquery filter language over decoded XDR values
+(ref src/util/xdrquery — SURVEY.md §2.15)."""
+import pytest
+
+from stellar_core_tpu.crypto import SecretKey, sha256
+from stellar_core_tpu.transactions import utils as U
+from stellar_core_tpu.utils.xdrquery import (
+    QueryError, compile_query, query_entries,
+)
+
+
+def entries():
+    a = U.make_account_entry(sha256(b"qa"), 5_000_000_000, seq_num=7)
+    b = U.make_account_entry(sha256(b"qb"), 100, seq_num=1)
+    usd = U.make_asset(b"USD", sha256(b"qi"))
+    t = U.make_trustline_entry(sha256(b"qa"), usd, balance=42)
+    return [a, b, t]
+
+
+def test_account_balance_filter():
+    out = query_entries(entries(), "data.account.balance > 1000000")
+    assert len(out) == 1
+    assert out[0].data.value.balance == 5_000_000_000
+
+
+def test_union_arm_selects_type():
+    out = query_entries(entries(), "data.trustLine.balance == 42")
+    assert len(out) == 1
+
+
+def test_boolean_operators():
+    q = ("data.account.balance > 0 && data.account.seqNum >= 7 "
+         "|| data.trustLine.balance == 42")
+    assert len(query_entries(entries(), q)) == 2
+
+
+def test_bytes_vs_hex_literal():
+    target = sha256(b"qb").hex()
+    out = query_entries(entries(),
+                        f"data.account.accountID.value == '{target}'")
+    assert len(out) == 1
+    assert out[0].data.value.balance == 100
+
+
+def test_missing_path_fails_row():
+    assert query_entries(entries(), "data.offer.amount > 0") == []
+
+
+def test_not_and_parens():
+    out = query_entries(entries(),
+                        "!(data.account.balance > 1000) && "
+                        "data.account.seqNum == 1")
+    assert len(out) == 1
+
+
+def test_syntax_error():
+    with pytest.raises(QueryError):
+        compile_query("data.account.balance >")
+    with pytest.raises(QueryError):
+        compile_query("balance ??? 3")
