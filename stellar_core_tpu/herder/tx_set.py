@@ -197,9 +197,17 @@ class TxSetFrame:
             return {}
         verdicts: Dict[Tuple[bytes, bytes, bytes], bool] = {}
         if use_device:
+            import os
+
             import numpy as np
 
-            from ..ops.ed25519_kernel import verify_batch
+            # kernel tier: the XLA kernel lowers on every backend and is
+            # the safe default; CRYPTO_KERNEL=pallas opts the node into
+            # the Pallas TPU kernel (bench.py probes pallas itself)
+            if os.environ.get("CRYPTO_KERNEL", "xla") == "pallas":
+                from ..ops.ed25519_pallas import verify_batch
+            else:
+                from ..ops.ed25519_kernel import verify_batch
 
             n = len(triples)
             pk = np.frombuffer(

@@ -228,6 +228,38 @@ class ConstantProductInvariant(Invariant):
         return ""
 
 
+class OrderBookIsNotCrossed(Invariant):
+    """After any op touching offers, no asset pair's book may be crossed:
+    best A->B price times best B->A price >= 1 (ref
+    src/invariant/OrderBookIsNotCrossed.cpp; acceptance-time tests only
+    in the reference, always-on here)."""
+
+    NAME = "OrderBookIsNotCrossed"
+
+    def check_on_tx_apply(self, ltx, frame, ok: bool) -> str:
+        pairs = set()
+        for kb, entry in ltx._delta.items():
+            if kb.startswith(b"\xff"):
+                continue
+            for e in (entry, ltx.parent.get(kb)):
+                if e is not None and \
+                        e.data.type == T.LedgerEntryType.OFFER:
+                    o = e.data.value
+                    pairs.add((T.Asset.encode(o.selling),
+                               T.Asset.encode(o.buying)))
+        for selling, buying in pairs:
+            fwd = ltx.best_offer(selling, buying)
+            rev = ltx.best_offer(buying, selling)
+            if fwd is None or rev is None:
+                continue
+            fo, ro = fwd.data.value, rev.data.value
+            # crossed iff p_fwd * p_rev < 1
+            if fo.price.n * ro.price.n < fo.price.d * ro.price.d:
+                return (f"book crossed: {fo.price.n}/{fo.price.d} x "
+                        f"{ro.price.n}/{ro.price.d} < 1")
+        return ""
+
+
 def _account_kb(account_id: bytes) -> bytes:
     k = T.LedgerKey.make(
         T.LedgerEntryType.ACCOUNT,
@@ -238,7 +270,7 @@ def _account_kb(account_id: bytes) -> bytes:
 
 ALL_INVARIANTS = [LedgerEntryIsValid, ConservationOfLumens,
                   AccountSubEntriesCountIsValid, SponsorshipCountIsValid,
-                  ConstantProductInvariant]
+                  ConstantProductInvariant, OrderBookIsNotCrossed]
 
 
 class InvariantManager:

@@ -132,9 +132,22 @@ class Application:
     def connect_known_peers(self) -> None:
         from ..overlay.tcp_peer import connect_to
 
+        pm = self.overlay_manager.peer_manager
+        known = []
         for addr in self.config.KNOWN_PEERS:
             host, _, port = addr.partition(":")
-            connect_to(self, host or "127.0.0.1", int(port or 11625))
+            known.append((host or "127.0.0.1", int(port or 11625)))
+        if pm is not None:
+            for host, port in known:
+                pm.ensure_exists(host, port)
+            targets = pm.peers_to_try(
+                self.config.TARGET_PEER_CONNECTIONS)
+        else:
+            targets = known
+        for host, port in targets:
+            peer = connect_to(self, host, port)
+            if peer is None and pm is not None:
+                pm.on_connect_failure(host, port)
 
     def graceful_stop(self) -> None:
         self.process_manager.shutdown()

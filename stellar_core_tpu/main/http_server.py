@@ -33,6 +33,8 @@ class CommandHandler:
             "ll": self.log_level,
             "surveytopology": self.survey_topology,
             "getsurveyresult": self.get_survey_result,
+            "bans": self.bans,
+            "unban": self.unban,
         }
 
     def handle(self, path: str, params: Dict[str, str]) -> tuple:
@@ -139,6 +141,23 @@ class CommandHandler:
         return 200, {"results": {
             k.hex()[:8]: v
             for k, v in om.survey_manager.results.items()}}
+
+    def bans(self, params):
+        om = self.app.overlay_manager
+        if om is None:
+            return 200, {"bans": []}
+        return 200, {"bans": [b.hex() for b in sorted(om.banned_peers)]}
+
+    def unban(self, params):
+        om = self.app.overlay_manager
+        node = params.get("node", "")
+        if om is None or not node:
+            return 400, {"error": "no overlay / missing node"}
+        try:
+            om.unban_peer(bytes.fromhex(node))
+        except ValueError:
+            return 400, {"error": "bad node id"}
+        return 200, {"unbanned": node}
 
     def log_level(self, params):
         from ..utils import logging as L

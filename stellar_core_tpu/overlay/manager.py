@@ -77,6 +77,19 @@ class OverlayManager:
         self.trackers: Dict[bytes, ItemTracker] = {}
         self.banned_peers: Set[bytes] = set()
         self.survey_manager = SurveyManager(app)
+        # persisted peer DB + bans (present when the app has a Database;
+        # bare sims construct OverlayManager before app.database exists —
+        # only that case may degrade silently, real DB errors propagate)
+        if getattr(app, "database", None) is not None and \
+                hasattr(app.database, "conn"):
+            from .peer_manager import BanManager, PeerManager
+
+            self.peer_manager = PeerManager(app)
+            self.ban_manager = BanManager(app)
+            self.banned_peers.update(self.ban_manager.banned())
+        else:
+            self.peer_manager = None
+            self.ban_manager = None
         self._shutting_down = False
 
     # -- lifecycle ---------------------------------------------------------
@@ -100,10 +113,18 @@ class OverlayManager:
             self.pending_peers.remove(peer)
         self.authenticated[peer.peer_id] = peer
         self.app.metrics.counter("overlay.connection.authenticated").inc()
+        addr = getattr(peer, "remote_addr", None)
+        if addr is not None and self.peer_manager is not None:
+            self.peer_manager.on_connect_success(*addr)
 
     def peer_closed(self, peer, reason: str) -> None:
         if peer in self.pending_peers:
             self.pending_peers.remove(peer)
+            # an outbound connection that dies before authenticating is a
+            # connect failure for the peer DB's backoff accounting
+            addr = getattr(peer, "remote_addr", None)
+            if addr is not None and self.peer_manager is not None:
+                self.peer_manager.on_connect_failure(*addr)
         if peer.peer_id and self.authenticated.get(peer.peer_id) is peer:
             del self.authenticated[peer.peer_id]
 
@@ -112,9 +133,16 @@ class OverlayManager:
 
     def ban_peer(self, peer_id: bytes) -> None:
         self.banned_peers.add(peer_id)
+        if self.ban_manager is not None:
+            self.ban_manager.ban(peer_id)
         p = self.authenticated.get(peer_id)
         if p is not None:
             p.close("banned")
+
+    def unban_peer(self, peer_id: bytes) -> None:
+        self.banned_peers.discard(peer_id)
+        if self.ban_manager is not None:
+            self.ban_manager.unban(peer_id)
 
     # -- broadcast (the flood network) --------------------------------------
 

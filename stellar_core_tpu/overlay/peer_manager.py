@@ -1,0 +1,126 @@
+"""PeerManager + BanManager: the persisted peer database
+(ref src/overlay/PeerManager.h:62 — peer records with failure counts and
+backoff; src/overlay/BanManager.h:19 — persisted bans;
+RandomPeerSource selection).
+
+Peer addresses live in the `peers` SQL table; connection outcomes update
+failure counts and next-attempt backoff; outbound selection prefers
+outbound-typed then fewest-failures with randomized tie-break."""
+from __future__ import annotations
+
+import random
+import time
+from typing import List, Optional, Tuple
+
+SCHEMA = """
+CREATE TABLE IF NOT EXISTS peers (
+    host TEXT NOT NULL,
+    port INTEGER NOT NULL,
+    nextattempt REAL NOT NULL DEFAULT 0,
+    numfailures INTEGER NOT NULL DEFAULT 0,
+    type INTEGER NOT NULL DEFAULT 0,
+    PRIMARY KEY (host, port)
+);
+CREATE TABLE IF NOT EXISTS bans (
+    nodeid BLOB PRIMARY KEY
+);
+"""
+
+# peer types (ref PeerType)
+INBOUND = 0
+OUTBOUND = 1
+PREFERRED = 2
+
+MAX_FAILURES = 10
+BACKOFF_BASE_SECONDS = 30.0
+
+
+class PeerManager:
+    def __init__(self, app):
+        self.app = app
+        app.database.conn.executescript(SCHEMA)
+        self._rng = random.Random(0xB5)
+
+    # -- record lifecycle ----------------------------------------------------
+
+    def ensure_exists(self, host: str, port: int,
+                      ptype: int = OUTBOUND) -> None:
+        self.app.database.execute(
+            "INSERT INTO peers(host, port, type) VALUES(?,?,?) "
+            "ON CONFLICT(host, port) DO NOTHING", (host, port, ptype))
+        self.app.database.commit()
+
+    def on_connect_success(self, host: str, port: int) -> None:
+        self.app.database.execute(
+            "UPDATE peers SET numfailures=0, nextattempt=0 "
+            "WHERE host=? AND port=?", (host, port))
+        self.app.database.commit()
+
+    def on_connect_failure(self, host: str, port: int) -> None:
+        """Exponential backoff on repeated failures
+        (ref PeerManager::update on failure)."""
+        now = self._now()
+        row = self.app.database.execute(
+            "SELECT numfailures FROM peers WHERE host=? AND port=?",
+            (host, port)).fetchone()
+        failures = (row[0] if row else 0) + 1
+        backoff = BACKOFF_BASE_SECONDS * (2 ** min(failures, 8))
+        self.app.database.execute(
+            "INSERT INTO peers(host, port, numfailures, nextattempt) "
+            "VALUES(?,?,?,?) ON CONFLICT(host, port) DO UPDATE SET "
+            "numfailures=excluded.numfailures, "
+            "nextattempt=excluded.nextattempt",
+            (host, port, failures, now + backoff))
+        self.app.database.commit()
+
+    def _now(self) -> float:
+        clock = getattr(self.app, "clock", None)
+        return clock.system_now() if clock is not None else time.time()
+
+    # -- selection (ref RandomPeerSource) ------------------------------------
+
+    def peers_to_try(self, count: int) -> List[Tuple[str, int]]:
+        """Connectable candidates: past their backoff, preferred/outbound
+        first, fewest failures next, randomized within rank.  Failure
+        counts only lengthen the (capped exponential) backoff — a peer is
+        never excluded permanently, so a host outage can always be
+        recovered from."""
+        now = self._now()
+        rows = self.app.database.execute(
+            "SELECT host, port, type, numfailures FROM peers "
+            "WHERE nextattempt <= ?", (now,)).fetchall()
+        self._rng.shuffle(rows)
+        rows.sort(key=lambda r: (-r[2], r[3]))
+        return [(r[0], r[1]) for r in rows[:count]]
+
+    def all_peers(self) -> List[Tuple[str, int, int, int]]:
+        return self.app.database.execute(
+            "SELECT host, port, type, numfailures FROM peers").fetchall()
+
+
+class BanManager:
+    """Persisted node bans (ref src/overlay/BanManager.h:19)."""
+
+    def __init__(self, app):
+        self.app = app
+        app.database.conn.executescript(SCHEMA)
+
+    def ban(self, node_id: bytes) -> None:
+        self.app.database.execute(
+            "INSERT INTO bans(nodeid) VALUES(?) "
+            "ON CONFLICT(nodeid) DO NOTHING", (node_id,))
+        self.app.database.commit()
+
+    def unban(self, node_id: bytes) -> None:
+        self.app.database.execute(
+            "DELETE FROM bans WHERE nodeid=?", (node_id,))
+        self.app.database.commit()
+
+    def is_banned(self, node_id: bytes) -> bool:
+        return self.app.database.execute(
+            "SELECT 1 FROM bans WHERE nodeid=?",
+            (node_id,)).fetchone() is not None
+
+    def banned(self) -> List[bytes]:
+        return [r[0] for r in self.app.database.execute(
+            "SELECT nodeid FROM bans").fetchall()]

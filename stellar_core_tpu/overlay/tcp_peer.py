@@ -172,6 +172,7 @@ def connect_to(app, host: str, port: int) -> Optional[TCPPeer]:
             sock.close()
             return None
     peer = TCPPeer(app, PeerRole.INITIATOR, sock)
+    peer.remote_addr = (host, port)  # for peer-DB outcome recording
     app.overlay_manager.add_pending_peer(peer)
     app.tcp_io.register(sock, peer.on_readable)
     peer.start_handshake()

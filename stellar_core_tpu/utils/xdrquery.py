@@ -161,6 +161,10 @@ def compile_query(src: str):
     """Compile to a predicate over decoded XDR values."""
     ast = _Parser(_tokenize(src)).parse()
 
+    def truthy(x) -> bool:
+        # an unresolved path is NULL-ish: false in any boolean context
+        return x is not MISSING and bool(x)
+
     def evaluate(node, value):
         kind = node[0]
         if kind == "lit":
@@ -168,13 +172,13 @@ def compile_query(src: str):
         if kind == "path":
             return resolve_path(value, node[1])
         if kind == "and":
-            return bool(evaluate(node[1], value)) and \
-                bool(evaluate(node[2], value))
+            return truthy(evaluate(node[1], value)) and \
+                truthy(evaluate(node[2], value))
         if kind == "or":
-            return bool(evaluate(node[1], value)) or \
-                bool(evaluate(node[2], value))
+            return truthy(evaluate(node[1], value)) or \
+                truthy(evaluate(node[2], value))
         if kind == "not":
-            return not bool(evaluate(node[1], value))
+            return not truthy(evaluate(node[1], value))
         if kind == "cmp":
             _, op, ln, rn = node
             lv = evaluate(ln, value)

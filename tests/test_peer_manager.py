@@ -1,0 +1,72 @@
+"""Persisted peer DB + bans (ref src/overlay/PeerManager.h,
+BanManager.h)."""
+from stellar_core_tpu.main import Application, test_config
+from stellar_core_tpu.overlay.peer_manager import BanManager, PeerManager
+from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+
+
+def _app(db=":memory:", **kw):
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME),
+                      test_config(DATABASE=db, **kw))
+    app.start()
+    return app
+
+
+def test_peer_records_and_backoff():
+    app = _app()
+    pm = PeerManager(app)
+    pm.ensure_exists("127.0.0.1", 1111)
+    pm.ensure_exists("127.0.0.1", 2222)
+    assert len(pm.peers_to_try(10)) == 2
+    # failures push the peer past its backoff window
+    pm.on_connect_failure("127.0.0.1", 1111)
+    assert pm.peers_to_try(10) == [("127.0.0.1", 2222)]
+    # success resets
+    pm.on_connect_success("127.0.0.1", 1111)
+    assert len(pm.peers_to_try(10)) == 2
+
+
+def test_failures_back_off_but_never_exclude_forever():
+    app = _app()
+    pm = PeerManager(app)
+    pm.ensure_exists("10.0.0.1", 1)
+    for _ in range(12):
+        pm.on_connect_failure("10.0.0.1", 1)
+    # inside the backoff window: not offered
+    assert ("10.0.0.1", 1) not in pm.peers_to_try(10)
+    # far in the future the peer becomes connectable again (capped
+    # exponential backoff, no permanent exclusion)
+    pm._now = lambda: 10**12
+    assert ("10.0.0.1", 1) in pm.peers_to_try(10)
+
+
+def test_bans_persist(tmp_path):
+    db = str(tmp_path / "peers.db")
+    app = _app(db=db)
+    bm = BanManager(app)
+    nid = b"\x09" * 32
+    bm.ban(nid)
+    assert bm.is_banned(nid)
+    app.database.close()
+    app2 = _app(db=db)
+    bm2 = BanManager(app2)
+    assert bm2.is_banned(nid)
+    bm2.unban(nid)
+    assert not bm2.is_banned(nid)
+
+
+def test_overlay_manager_loads_bans(tmp_path):
+    from stellar_core_tpu.overlay.manager import OverlayManager
+
+    db = str(tmp_path / "om.db")
+    app = _app(db=db)
+    app.overlay_manager = OverlayManager(app)
+    nid = b"\x0a" * 32
+    app.overlay_manager.ban_peer(nid)
+    app.database.close()
+
+    app2 = _app(db=db)
+    app2.overlay_manager = OverlayManager(app2)
+    assert nid in app2.overlay_manager.banned_peers
+    app2.overlay_manager.unban_peer(nid)
+    assert not app2.overlay_manager.ban_manager.is_banned(nid)
