@@ -255,9 +255,32 @@ class Herder:
 
     def start(self) -> None:
         self.state = HerderState.TRACKING
+        self._restore_scp_state()
         if not self.app.config.MANUAL_CLOSE:
             self._arm_trigger()
             self._arm_tracking_timer()
+
+    def _restore_scp_state(self) -> None:
+        """Re-ingest this node's persisted SCP envelopes for the latest
+        slot so a restarted validator can answer GET_SCP_STATE and
+        re-advertise its externalize immediately (ref Herder::start
+        restoring from HerderPersistence)."""
+        row = self.app.database.execute(
+            "SELECT MAX(ledgerseq) FROM scphistory").fetchone()
+        if not row or row[0] is None:
+            return
+        seq = row[0]
+        for (raw,) in self.app.database.execute(
+                "SELECT envelope FROM scphistory WHERE ledgerseq=?",
+                (seq,)).fetchall():
+            try:
+                env = T.SCPEnvelope.decode(raw)
+            except Exception:
+                continue
+            # statement state only — no protocol transitions (tx sets
+            # referenced by old envelopes are gone after a restart)
+            slot = self.scp.get_slot(env.statement.slotIndex)
+            slot.set_state_from_envelope(env)
 
     def _arm_trigger(self) -> None:
         cfg = self.app.config
@@ -388,6 +411,8 @@ class Herder:
 
     def value_externalized(self, slot_index: int, value: bytes) -> None:
         """ref valueExternalized :315 + processExternalized :266."""
+        if slot_index <= self.app.ledger_manager.last_closed_seq():
+            return  # already applied (e.g. restored SCP state at boot)
         sv = T.StellarValue.decode(value)
         tx_set = self.pending_envelopes.get_tx_set(sv.txSetHash)
         if tx_set is None:

@@ -191,6 +191,15 @@ class Application:
         self._meta_stream.append(meta)
         if len(self._meta_stream) > 64:
             self._meta_stream.pop(0)
+        # METADATA_OUTPUT_STREAM: append framed XDR to a file for
+        # downstream consumers (ref LedgerManagerImpl.cpp:738-757; the
+        # reference writes to a configured fd/file)
+        path = getattr(self.config, "METADATA_OUTPUT_STREAM", None)
+        if path:
+            data = T.LedgerCloseMeta.encode(meta)
+            with open(path, "ab") as f:
+                f.write(len(data).to_bytes(4, "big") + data)
+        self.metrics.meter("ledger.close.frame").mark()
 
     # -- status (ref getJsonInfo / 'info' endpoint) -------------------------
 

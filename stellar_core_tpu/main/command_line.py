@@ -108,6 +108,24 @@ def cmd_self_check(cfg: Config) -> int:
     checks["bucket_list"] = (
         app.bucket_manager.get_bucket_list_hash() == hdr.bucketListHash
         or hdr.bucketListHash == b"\x00" * 32)
+    # BucketListIsConsistentWithDatabase (ref src/invariant/
+    # BucketListIsConsistentWithDatabase.cpp, run here as the reference's
+    # self-check does): the SQL entry store must hold exactly the bucket
+    # list's live entries
+    if app.bucket_manager.get_bucket_list_hash() != b"\x00" * 32 and \
+            app.bucket_manager.bucket_list.levels[0].curr.entries:
+        live = app.bucket_manager.bucket_list.all_live_entries()
+        db_count = app.ledger_manager.root.count_entries()
+        consistent = len(live) == db_count
+        if consistent:
+            for kb, entry in live.items():
+                db_entry = app.ledger_manager.root.get(kb)
+                if db_entry is None or \
+                        T.LedgerEntry.encode(db_entry) != \
+                        T.LedgerEntry.encode(entry):
+                    consistent = False
+                    break
+        checks["bucketlist_consistent_with_database"] = consistent
     qic = app.herder.check_quorum_intersection()
     checks["quorum_intersection"] = qic.ok
     ok = all(checks.values())

@@ -47,6 +47,10 @@ class Config:
         # [(name, local-directory-path)] history archives to publish
         # to / catch up from (ref HISTORY config blocks)
         self.HISTORY_ARCHIVES: List[tuple] = kw.get("HISTORY_ARCHIVES", [])
+        # file path receiving length-framed LedgerCloseMeta XDR per close
+        # (ref METADATA_OUTPUT_STREAM, Config.h)
+        self.METADATA_OUTPUT_STREAM: Optional[str] = kw.get(
+            "METADATA_OUTPUT_STREAM")
 
         # upgrades this node votes for when nominating (ref Upgrades::
         # UpgradeParameters; None = don't propose)

@@ -103,6 +103,19 @@ class Slot:
         record, ref Slot::getLatestMessagesSend)."""
         return list(self.ballot.latest_envelopes.values())
 
+    def set_state_from_envelope(self, envelope) -> None:
+        """Restore persisted statement state WITHOUT driving protocol
+        transitions (ref Slot::setStateFromEnvelope — used by
+        Herder::restoreSCPState after a restart): the envelope becomes
+        the node's recorded latest message so GET_SCP_STATE and
+        re-broadcast work, but no attempt* logic runs."""
+        st = envelope.statement
+        if st.slotIndex != self.slot_index:
+            raise ValueError("envelope for wrong slot")
+        self.ballot.latest_envelopes[node_of(st)] = envelope
+        if node_of(st) == self.local_node.node_id:
+            self.ballot.last_envelope_emit = envelope
+
     # -- federated voting --------------------------------------------------
 
     def federated_accept(

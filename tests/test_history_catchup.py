@@ -301,3 +301,36 @@ class TestCatchup:
         # scp history for checkpoint 7 survives (regression: replayed
         # closes used to re-publish empty scp blobs over it)
         assert archive.get_xdr_gz("scp", checkpoint_name(7))
+
+
+class TestRestartScpState:
+    def test_scp_state_restored_on_boot(self, tmp_path):
+        """A restarted validator re-serves its latest externalize
+        statements (ref Herder::restoreSCPState)."""
+        db = tmp_path / "scp.db"
+        app = make_node(tmp_path, db=db)
+        close_ledgers_with_traffic(app, 4)
+        last = app.ledger_manager.last_closed_seq()
+        app.database.close()
+        del app
+        app2 = make_node(tmp_path, db=db)
+        msgs = app2.herder.scp.get_latest_messages_send(last)
+        assert msgs, "no SCP state restored for the last slot"
+        # and boot did NOT replay/advance anything
+        assert app2.ledger_manager.last_closed_seq() == last
+
+
+class TestMetaStreamFile:
+    def test_meta_stream_written_and_parsable(self, tmp_path):
+        path = tmp_path / "meta.xdr"
+        app = make_node(tmp_path)
+        app.config.METADATA_OUTPUT_STREAM = str(path)
+        close_ledgers_with_traffic(app, 3)
+        data = path.read_bytes()
+        frames = 0
+        while data:
+            n = int.from_bytes(data[:4], "big")
+            T.LedgerCloseMeta.decode(data[4:4 + n])
+            data = data[4 + n:]
+            frames += 1
+        assert frames == 3
