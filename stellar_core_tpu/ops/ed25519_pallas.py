@@ -135,11 +135,13 @@ def _row_add(x, i: int, v):
     v = jnp.asarray(v, jnp.int32)
     if v.ndim == 1:
         v = v[None, :]
-    return x + jnp.where(_rows(x) == i, 1, 0) * v
+    return x + _row_mask(x, i) * v
 
 
 def _row_mask(shape_like, i: int, on: int = 1, off: int = 0):
-    return jnp.where(_rows(shape_like) == i, on, off)
+    # explicit int32: with jax_enable_x64 on, python-int where-branches
+    # become weak int64, which mosaic cannot lower
+    return jnp.where(_rows(shape_like) == i, jnp.int32(on), jnp.int32(off))
 
 
 def _weak_carry(x, passes: int = 2):
@@ -228,8 +230,8 @@ def _sqr_times(a, n: int):
         for _ in range(n):
             a = _sqr(a)
         return a
-    return jax.lax.fori_loop(0, n, lambda _, x: _sqr(x), a,
-                             unroll=False)
+    return jax.lax.fori_loop(jnp.int32(0), jnp.int32(n),
+                             lambda _, x: _sqr(x), a, unroll=False)
 
 
 def _pow_250_1(z):
@@ -290,13 +292,20 @@ def _freeze(a, C):
     t = _carry_seq(t, NL)
     ge = (t[NL - 1] >> 3) > 0
     # mask row 21 down to its low 3 bits (row-masked, no concat)
-    t_mod = t - jnp.where(_rows(t) == NL - 1, 1, 0) * \
+    t_mod = t - _row_mask(t, NL - 1) * \
         ((t[NL - 1] - (t[NL - 1] & 7))[None, :])
     return jnp.where(ge[None, :], t_mod, x)
 
 
+def _all_rows(cond):
+    """jnp.all over the sublane axis as an int32 sum — mosaic lowers bool
+    reductions via f64 min, which it then fails to compile."""
+    return jnp.sum(cond.astype(jnp.int32), axis=0,
+                   dtype=jnp.int32) == jnp.int32(cond.shape[0])
+
+
 def _is_zero(a, C):
-    return jnp.all(_freeze(a, C) == 0, axis=0)
+    return _all_rows(_freeze(a, C) == 0)
 
 
 # ---------------------------------------------------------------------------
@@ -341,8 +350,7 @@ def _point_neg(p):
 
 def _ident_pt(bsz):
     zero = jnp.zeros((NL, bsz), dtype=jnp.int32)
-    one = jnp.where(
-        jax.lax.broadcasted_iota(jnp.int32, (NL, bsz), 0) == 0, 1, 0)
+    one = _row_mask(zero, 0)
     return (zero, one, one, zero)
 
 
@@ -381,7 +389,7 @@ def _verify_kernel(consts_ref, ya_ref, yr_ref, sdig_ref, hdig_ref, out_ref,
     x = jnp.where(on_curve_flipped[None, :], _mul(x, C.sqrt_m1), x)
     a_ok = on_curve_direct | on_curve_flipped
     xf = _freeze(x, C)
-    x_is_zero = jnp.all(xf == 0, axis=0)
+    x_is_zero = _all_rows(xf == 0)
     a_ok = a_ok & ~(x_is_zero & (sign == 1))
     flip = ((xf[0] & 1) != sign)[None, :]
     x = jnp.where(flip, _weak_carry(-x), x)
@@ -402,12 +410,12 @@ def _verify_kernel(consts_ref, ya_ref, yr_ref, sdig_ref, hdig_ref, out_ref,
     def build(i, acc_pt):
         nxt = _point_add(acc_pt, neg_a, C)
         for c in range(4):
-            pl.store(tab_refs[c],
-                     (pl.dslice((i + 2) * SL, SL), slice(None)),
-                     _pad_rows(nxt[c], 0, SL - NL))
+            tab_refs[c][pl.dslice((i + 2) * SL, SL), :] = \
+                _pad_rows(nxt[c], 0, SL - NL)
         return nxt
 
-    jax.lax.fori_loop(0, 14, build, neg_a, unroll=False)
+    jax.lax.fori_loop(jnp.int32(0), jnp.int32(14), build, neg_a,
+                      unroll=False)
 
     # --- MSB-first shared-doubling ladder over 64 4-bit digit slots ---
     def select_rt(dig):
@@ -427,29 +435,26 @@ def _verify_kernel(consts_ref, ya_ref, yr_ref, sdig_ref, hdig_ref, out_ref,
                 sel[c] = sel[c] + m * C.btab[w][c]
         return tuple(sel)
 
-    sdig = sdig_ref[...]
-    hdig = hdig_ref[...]
-
     def step(i, acc_pt):
-        # digit index 63-i (MSB first)
-        sd = jax.lax.dynamic_index_in_dim(sdig, 63 - i, axis=0,
-                                          keepdims=False)
-        hd = jax.lax.dynamic_index_in_dim(hdig, 63 - i, axis=0,
-                                          keepdims=False)
+        # digit index 63-i (MSB first); dynamic-index the input refs —
+        # mosaic lowers ref dynamic slices but not value dynamic_slice
+        sd = sdig_ref[pl.dslice(jnp.int32(63) - i, 1), :][0]
+        hd = hdig_ref[pl.dslice(jnp.int32(63) - i, 1), :][0]
         for _ in range(4):
             acc_pt = _point_double(acc_pt)
         acc_pt = _point_add(acc_pt, select_const(sd), C)
         acc_pt = _point_add(acc_pt, select_rt(hd), C)
         return acc_pt
 
-    accp = jax.lax.fori_loop(0, 64, step, _ident_pt(bsz), unroll=False)
+    accp = jax.lax.fori_loop(jnp.int32(0), jnp.int32(64), step,
+                             _ident_pt(bsz), unroll=False)
 
     # --- encode R' and compare against R bytes (limb-space compare) ---
     zi = _inv(accp[2])
     xa = _freeze(_mul(accp[0], zi), C)
     ya_out = _freeze(_mul(accp[1], zi), C)
     yr24 = yr_ref[...]
-    match = jnp.all(ya_out == _freeze(yr24[:NL], C), axis=0)
+    match = _all_rows(ya_out == _freeze(yr24[:NL], C))
     match = match & ((xa[0] & 1) == yr24[NL])
     ok = (match & a_ok).astype(jnp.int32)
     out_ref[...] = jnp.broadcast_to(ok[None, :], (8, bsz))
@@ -528,17 +533,23 @@ def verify_batch(pubkeys, sigs, msgs, interpret: bool = False,
     spec_l = pl.BlockSpec((24, BLOCK), lambda i: (0, i))
     spec_d = pl.BlockSpec((64, BLOCK), lambda i: (0, i))
     spec_o = pl.BlockSpec((8, BLOCK), lambda i: (0, i))
-    ok_core = pl.pallas_call(
-        _verify_kernel,
-        grid=grid,
-        in_specs=[spec_c, spec_l, spec_l, spec_d, spec_d],
-        out_specs=spec_o,
-        out_shape=jax.ShapeDtypeStruct((8, ntot), jnp.int32),
-        scratch_shapes=[pltpu.VMEM((16 * SL, BLOCK), jnp.int32)
-                        for _ in range(4)],
-        interpret=interpret,
-    )(jnp.asarray(_consts_np()), pack24(ya, sign_a), pack24(yr, sign_r),
-      s_digits.T.astype(jnp.int32), h_digits.T.astype(jnp.int32))
+    # trace the kernel with x64 off: the framework enables jax_enable_x64
+    # globally, which turns python-int literals (index maps, loop bounds,
+    # where-branches) into weak int64 — mosaic has no 64-bit lowering.
+    # All kernel operands/results are explicit int32, so this is a pure
+    # trace-time dtype scope, not a value change.
+    with jax.enable_x64(False):
+        ok_core = pl.pallas_call(
+            _verify_kernel,
+            grid=grid,
+            in_specs=[spec_c, spec_l, spec_l, spec_d, spec_d],
+            out_specs=spec_o,
+            out_shape=jax.ShapeDtypeStruct((8, ntot), jnp.int32),
+            scratch_shapes=[pltpu.VMEM((16 * SL, BLOCK), jnp.int32)
+                            for _ in range(4)],
+            interpret=interpret,
+        )(jnp.asarray(_consts_np()), pack24(ya, sign_a), pack24(yr, sign_r),
+          s_digits.T.astype(jnp.int32), h_digits.T.astype(jnp.int32))
 
     ok = (ok_core[0] == 1) & s_ok & canon & ~small_a & ~small_r
     return ok[:n]

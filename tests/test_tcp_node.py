@@ -95,7 +95,7 @@ validators = [{validators}]
                     for p in http_ports]
 
         t0 = time.time()
-        while time.time() - t0 < 60:
+        while time.time() - t0 < 150:
             try:
                 h = heights()
                 if min(h) >= 3:
@@ -140,7 +140,7 @@ validators = [{validators}]
         # the tx floods to node 1 and both apply it
         t0 = time.time()
         applied = False
-        while time.time() - t0 < 60:
+        while time.time() - t0 < 150:
             infos = [_http(p, "info")["info"] for p in http_ports]
             if all(i["pending_txs"] == 0 for i in infos) and \
                     min(i["ledger"]["num"] for i in infos) >= 4:
