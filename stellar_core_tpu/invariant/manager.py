@@ -260,6 +260,120 @@ class OrderBookIsNotCrossed(Invariant):
         return ""
 
 
+class LiabilitiesMatchOffers(Invariant):
+    """Liabilities stay in sync with the offer book, and balances/limits
+    respect liabilities and reserve (ref
+    src/invariant/LiabilitiesMatchOffers.cpp).
+
+    Two checks per applied operation:
+    1. delta sync: summed offer buying/selling liabilities per
+       (account, asset) must move exactly with the account/trustline
+       liability fields;
+    2. bound checks on entries whose balance decreased or liabilities
+       increased: account balance - selling >= minBalance,
+       INT64_MAX - balance >= buying; trustline selling <= balance,
+       buying <= limit - balance; unauthorized trustlines hold zero
+       liabilities.
+    """
+
+    NAME = "LiabilitiesMatchOffers"
+
+    def check_on_tx_apply(self, ltx, frame, ok: bool) -> str:
+        from ..transactions.offer_exchange import (
+            offer_buying_liabilities, offer_selling_liabilities,
+        )
+
+        LE = T.LedgerEntryType
+        native = T.Asset.encode(U.asset_native())
+        delta: dict = {}  # (accountID, asset bytes) -> [buying, selling]
+
+        def bump(aid, asset, buying, selling, sign):
+            key = (aid, asset)
+            cur = delta.setdefault(key, [0, 0])
+            cur[0] += sign * buying
+            cur[1] += sign * selling
+
+        header = ltx.header()
+        for kb, entry in ltx._delta.items():
+            if kb.startswith(b"\xff"):
+                continue
+            old = ltx.parent.get(kb)
+            for e, sign in ((entry, 1), (old, -1)):
+                if e is None:
+                    continue
+                d = e.data
+                if d.type == LE.OFFER:
+                    o = d.value
+                    aid = o.sellerID.value
+                    # issuer sides carry no liabilities (mirrors
+                    # apply_offer_liabilities / ref addOrSubtract...)
+                    if U.asset_issuer(o.buying) != aid:
+                        bump(aid, T.Asset.encode(o.buying),
+                             offer_buying_liabilities(o.price, o.amount),
+                             0, sign)
+                    if U.asset_issuer(o.selling) != aid:
+                        bump(aid, T.Asset.encode(o.selling), 0,
+                             offer_selling_liabilities(o.price, o.amount),
+                             sign)
+                elif d.type == LE.ACCOUNT:
+                    b, s = U.account_liabilities(d.value)
+                    bump(d.value.accountID.value, native, b, s, -sign)
+                elif d.type == LE.TRUSTLINE:
+                    tl = d.value
+                    if tl.asset.type == T.AssetType.ASSET_TYPE_POOL_SHARE:
+                        continue  # pool shares carry no offer liabilities
+                    b, s = U.trustline_liabilities(tl)
+                    bump(tl.accountID.value,
+                         T.TrustLineAsset.encode(tl.asset), b, s, -sign)
+            # bound checks on the post-state only
+            if entry is None:
+                continue
+            d = entry.data
+            if d.type == LE.ACCOUNT:
+                acc = d.value
+                buying, selling = U.account_liabilities(acc)
+                old_b, old_s = (U.account_liabilities(old.data.value)
+                                if old is not None else (0, 0))
+                went_down = old is not None and \
+                    acc.balance < old.data.value.balance
+                if went_down or buying > old_b or selling > old_s:
+                    if acc.balance - selling < U.min_balance(header, acc):
+                        return (f"account balance {acc.balance} below "
+                                f"reserve + selling liabilities {selling}")
+                    if U.INT64_MAX - acc.balance < buying:
+                        return "account buying liabilities overflow"
+            elif d.type == LE.TRUSTLINE:
+                tl = d.value
+                if tl.asset.type == T.AssetType.ASSET_TYPE_POOL_SHARE:
+                    continue
+                buying, selling = U.trustline_liabilities(tl)
+                if not U.is_authorized_to_maintain_liabilities(tl):
+                    if buying or selling:
+                        return ("unauthorized trustline holds "
+                                "liabilities")
+                    continue
+                old_b, old_s = (
+                    U.trustline_liabilities(old.data.value)
+                    if old is not None else (0, 0))
+                went_down = old is not None and (
+                    tl.balance < old.data.value.balance
+                    or tl.limit < old.data.value.limit)
+                if went_down or buying > old_b or selling > old_s:
+                    if selling > tl.balance:
+                        return (f"trustline selling liabilities {selling} "
+                                f"exceed balance {tl.balance}")
+                    if buying > tl.limit - tl.balance:
+                        return (f"trustline buying liabilities {buying} "
+                                f"exceed limit headroom")
+
+        for (aid, asset), (b, s) in delta.items():
+            if b != 0 or s != 0:
+                return (f"offer liabilities out of sync for account "
+                        f"{aid[:4].hex()}: buying delta {b}, selling "
+                        f"delta {s}")
+        return ""
+
+
 def _account_kb(account_id: bytes) -> bytes:
     k = T.LedgerKey.make(
         T.LedgerEntryType.ACCOUNT,
@@ -270,7 +384,8 @@ def _account_kb(account_id: bytes) -> bytes:
 
 ALL_INVARIANTS = [LedgerEntryIsValid, ConservationOfLumens,
                   AccountSubEntriesCountIsValid, SponsorshipCountIsValid,
-                  ConstantProductInvariant, OrderBookIsNotCrossed]
+                  ConstantProductInvariant, OrderBookIsNotCrossed,
+                  LiabilitiesMatchOffers]
 
 
 class InvariantManager:

@@ -271,17 +271,54 @@ class LedgerTxn(AbstractLedgerTxn):
 
         selling/buying are canonical XDR Asset encodings."""
         self._check_open()
-        # collect delta offers (and deletions) up the chain
+        overrides, root = self._collect_offer_overrides()
+        return root._best_offer(
+            selling_bytes, buying_bytes, overrides, worse_than)
+
+    def _collect_offer_overrides(self):
+        return self._collect_overrides(_OFFER_PREFIX)
+
+    def _collect_overrides(self, prefix: bytes):
+        """Uncommitted delta entries (and deletions) with the given key
+        prefix up the layer chain, nearest layer winning, plus the root."""
         overrides: Dict[bytes, Optional[object]] = {}
         layer = self
         while isinstance(layer, LedgerTxn):
             for kb, e in layer._delta.items():
-                if kb not in overrides and kb.startswith(_OFFER_PREFIX):
+                if kb not in overrides and kb.startswith(prefix):
                     overrides[kb] = e
             layer = layer.parent
-        root: LedgerTxnRoot = layer
-        return root._best_offer(
-            selling_bytes, buying_bytes, overrides, worse_than)
+        return overrides, layer
+
+    def offers_by_account(self, account_id: bytes):
+        """All live offers owned by ``account_id``, delta-aware (ref
+        loadOffersByAccountAndAsset, LedgerTxn.cpp — asset filtering is
+        the caller's job)."""
+        self._check_open()
+        overrides, root = self._collect_offer_overrides()
+        out = []
+        for kb, e in root._offers_by_seller(account_id):
+            if kb in overrides:
+                continue
+            out.append(e)
+        for kb, e in overrides.items():
+            if e is not None and \
+                    e.data.value.sellerID.value == account_id:
+                out.append(e)
+        return out
+
+    def entries_by_key_prefix(self, prefix: bytes):
+        """All live entries whose encoded LedgerKey starts with ``prefix``,
+        delta-aware (used for by-account scans: trustlines of an account
+        share the type+accountID key prefix)."""
+        self._check_open()
+        overrides, root = self._collect_overrides(prefix)
+        out = []
+        for kb, e in root._entries_by_key_prefix(prefix):
+            if kb not in overrides:
+                out.append(e)
+        out.extend(e for e in overrides.values() if e is not None)
+        return out
 
     def header_ledger_seq(self) -> int:
         return self.header().ledgerSeq
@@ -409,6 +446,21 @@ class LedgerTxnRoot(AbstractLedgerTxn):
             e = self.get(kb)
         return e
 
+    def _entries_by_key_prefix(self, prefix: bytes):
+        hi = prefix + b"\xff" * 8
+        for kb, blob in self.db.execute(
+                "SELECT key, entry FROM ledgerentries "
+                "WHERE key >= ? AND key <= ?", (prefix, hi)):
+            if kb.startswith(prefix):
+                yield kb, T.LedgerEntry.decode(blob)
+
+    def _offers_by_seller(self, sellerid: bytes):
+        for kb, blob in self.db.execute(
+                "SELECT o.key, e.entry FROM offers o "
+                "JOIN ledgerentries e ON e.key = o.key "
+                "WHERE o.sellerid = ?", (sellerid,)):
+            yield kb, T.LedgerEntry.decode(blob)
+
     def count_entries(self) -> int:
         return self.db.execute(
             "SELECT COUNT(*) FROM ledgerentries").fetchone()[0]
@@ -439,6 +491,7 @@ CREATE TABLE IF NOT EXISTS offers (
 );
 CREATE INDEX IF NOT EXISTS idx_offers_book
     ON offers(selling, buying, price, offerid);
+CREATE INDEX IF NOT EXISTS idx_offers_seller ON offers(sellerid);
 CREATE TABLE IF NOT EXISTS ledgerheaders (
     ledgerseq INTEGER PRIMARY KEY,
     data BLOB NOT NULL

@@ -178,3 +178,121 @@ def swap_in_given_out(reserves_in: int, reserves_out: int, amount_out: int,
     if amt > INT64_MAX - reserves_in:
         return None
     return amt
+
+
+# -- auth revocation: redeem pool-share trustlines (CAP-38) ------------------
+
+def redeem_pool_share_trustlines(ltx, trustor_id: bytes, asset,
+                                 balance_id_for) -> None:
+    """Full auth revocation of ``asset``: every pool-share trustline of
+    the trustor whose pool contains the asset is redeemed — the share
+    balance is withdrawn from the pool and parked in unconditional
+    claimable balances for the trustor (ref
+    removeOffersAndPoolShareTrustLines + CAP-38,
+    src/transactions/TransactionUtils.cpp).
+
+    ``balance_id_for(pool_id, withdrawn_asset) -> bytes32`` derives the
+    ClaimableBalanceID from the revoking operation's RevokeID preimage.
+    The trustline is removed before the claimable balances are created,
+    so the freed 2-subentry reserve covers the new entries."""
+    from ..ledger.ledger_txn import entry_to_key
+    from . import sponsorship as SP
+
+    header = ltx.header()
+    prefix = (T.LedgerEntryType.encode(T.LedgerEntryType.TRUSTLINE)
+              + T.AccountID.encode(T.account_id(trustor_id)))
+    for entry in list(ltx.entries_by_key_prefix(prefix)):
+        tl = entry.data.value
+        if tl.asset.type != T.AssetType.ASSET_TYPE_POOL_SHARE:
+            continue
+        pool_id = tl.asset.value
+        pool_entry = load_pool(ltx, pool_id)
+        if pool_entry is None:
+            raise RuntimeError("pool-share trustline without pool")
+        cp = constant_product(pool_entry)
+        if compare_assets(cp.params.assetA, asset) != 0 and \
+                compare_assets(cp.params.assetB, asset) != 0:
+            continue
+
+        balance = tl.balance
+        amount_a = amount_b = 0
+        if balance > 0:
+            amount_a = get_pool_withdrawal_amount(
+                balance, cp.totalPoolShares, cp.reserveA)
+            amount_b = get_pool_withdrawal_amount(
+                balance, cp.totalPoolShares, cp.reserveB)
+
+        # the claimable balances inherit the trustline's reserve payer
+        # (CAP-38: sponsored by the pool-share trustline's sponsor, else
+        # the trustor; created WITHOUT a min-balance check since the
+        # trustline's freed reserve covers them)
+        tl_sponsor = SP.entry_sponsor(entry)
+
+        # 1. drop the trustline (frees its reserve for the new entries)
+        SP.remove_entry_with_possible_sponsorship(ltx, entry, trustor_id)
+        ltx.erase(entry_to_key(entry))
+        for underlying in (cp.params.assetA, cp.params.assetB):
+            if U.is_native(underlying) or \
+                    U.asset_issuer(underlying) == trustor_id:
+                continue
+            utl = ltx.load_trustline(trustor_id, underlying)
+            if utl is not None:
+                from .operations.base import put_trustline
+
+                put_trustline(ltx, utl,
+                              tl_with_pool_use_delta(utl.data.value, -1))
+
+        # 2. shrink the pool
+        cp2 = cp._replace(
+            reserveA=cp.reserveA - amount_a,
+            reserveB=cp.reserveB - amount_b,
+            totalPoolShares=cp.totalPoolShares - balance,
+            poolSharesTrustLineCount=cp.poolSharesTrustLineCount - 1)
+        if cp2.poolSharesTrustLineCount == 0:
+            ltx.erase(entry_to_key(pool_entry))
+        else:
+            ltx.put(pool_with_cp(pool_entry, cp2))
+
+        # 3. park the withdrawn amounts in claimable balances
+        close_time = header.scpValue.closeTime  # noqa: F841 (parity note)
+        for amt, a in ((amount_a, cp.params.assetA),
+                       (amount_b, cp.params.assetB)):
+            if amt <= 0:
+                continue
+            clawback = False
+            if not U.is_native(a) and U.asset_issuer(a) != trustor_id:
+                utl = ltx.load_trustline(trustor_id, a)
+                if utl is not None:
+                    clawback = U.is_clawback_enabled_tl(utl.data.value)
+            bid = T.ClaimableBalanceID.make(
+                T.ClaimableBalanceIDType.CLAIMABLE_BALANCE_ID_TYPE_V0,
+                balance_id_for(pool_id, a))
+            if clawback:
+                ext = T.ClaimableBalanceEntry.fields[4][1].make(
+                    1, T.ClaimableBalanceEntryExtensionV1.make(
+                        ext=T.ClaimableBalanceEntryExtensionV1
+                        .fields[0][1].make(0),
+                        flags=T.CLAIMABLE_BALANCE_CLAWBACK_ENABLED_FLAG))
+            else:
+                ext = T.ClaimableBalanceEntry.fields[4][1].make(0)
+            claimant = T.Claimant.make(
+                T.ClaimantType.CLAIMANT_TYPE_V0,
+                T.Claimant.arms[T.ClaimantType.CLAIMANT_TYPE_V0][1].make(
+                    destination=T.account_id(trustor_id),
+                    predicate=T.ClaimPredicate.make(
+                        T.ClaimPredicateType
+                        .CLAIM_PREDICATE_UNCONDITIONAL)))
+            cb = T.ClaimableBalanceEntry.make(
+                balanceID=bid, claimants=[claimant], asset=a,
+                amount=amt, ext=ext)
+            cb_entry = U.wrap_entry(T.LedgerEntryType.CLAIMABLE_BALANCE,
+                                    cb)
+            sponsor_id = (tl_sponsor if tl_sponsor is not None
+                          else trustor_id)
+            sp_entry = ltx.load_account(sponsor_id)
+            if sp_entry is None:
+                raise RuntimeError("revoke sponsor account missing")
+            SP._put_account(ltx, sp_entry, SP.add_num_sponsoring(
+                sp_entry.data.value, 1))
+            cb_entry = SP.set_entry_sponsor(cb_entry, sponsor_id)
+            ltx.put(cb_entry)

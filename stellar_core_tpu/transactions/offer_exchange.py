@@ -184,6 +184,62 @@ def offer_buying_liabilities(price, amount: int) -> int:
     return res.num_sheep_send
 
 
+def apply_offer_liabilities(ltx, oe, sign: int) -> bool:
+    """Acquire (sign=+1) or release (-1) a resting offer's liabilities
+    on the owner's account / trustlines (ref acquireLiabilities /
+    releaseLiabilities, src/transactions/TransactionUtils.cpp:100-190).
+
+    Acquire enforces the balance/limit headroom bounds and returns False
+    when the offer does not fit (callers size offers so that cannot
+    happen); release only asserts non-negativity.  Issuer sides carry no
+    liabilities."""
+    from .operations.base import put_account, put_trustline
+
+    seller = oe.sellerID.value
+    header = ltx.header()
+    for asset, is_buy in ((oe.selling, False), (oe.buying, True)):
+        liab = (offer_buying_liabilities(oe.price, oe.amount) if is_buy
+                else offer_selling_liabilities(oe.price, oe.amount))
+        delta = sign * liab
+        if delta == 0:
+            continue
+        if U.is_native(asset):
+            entry = ltx.load_account(seller)
+            if entry is None:
+                return False
+            acc = entry.data.value
+            b, s = U.account_liabilities(acc)
+            if is_buy:
+                b += delta
+                if b < 0 or (sign > 0 and b > U.INT64_MAX - acc.balance):
+                    return False
+            else:
+                s += delta
+                if s < 0 or (sign > 0 and
+                             s > acc.balance - U.min_balance(header, acc)):
+                    return False
+            put_account(ltx, entry, U.set_account_liabilities(acc, b, s))
+        elif U.asset_issuer(asset) == seller:
+            continue
+        else:
+            tl_entry = ltx.load_trustline(seller, asset)
+            if tl_entry is None:
+                return False
+            tl = tl_entry.data.value
+            b, s = U.trustline_liabilities(tl)
+            if is_buy:
+                b += delta
+                if b < 0 or (sign > 0 and b > tl.limit - tl.balance):
+                    return False
+            else:
+                s += delta
+                if s < 0 or (sign > 0 and s > tl.balance):
+                    return False
+            put_trustline(ltx, tl_entry,
+                          U.set_trustline_liabilities(tl, b, s))
+    return True
+
+
 # -- seller capacity (ref canSellAtMost / canBuyAtMost :55-107) ---------------
 
 def can_sell_at_most(header, ltx, account_id: bytes, asset) -> int:
@@ -231,10 +287,14 @@ def _credit(ltx, header, account_id: bytes, asset, delta: int) -> bool:
         entry = ltx.load_account(account_id)
         if entry is None:
             return False
-        acc = U.add_balance(entry.data.value, delta)
-        if acc is None:
+        acc = entry.data.value
+        buying, selling = U.account_liabilities(acc)
+        nb = acc.balance + delta
+        # liabilities-aware bounds (ref addBalance for accounts:
+        # [selling, INT64_MAX - buying]; reserve is the caller's check)
+        if nb < selling or nb > U.INT64_MAX - buying:
             return False
-        put_account(ltx, entry, acc)
+        put_account(ltx, entry, acc._replace(balance=nb))
         return True
     if U.asset_issuer(asset) == account_id:
         return True  # issuers mint/burn freely
@@ -242,8 +302,9 @@ def _credit(ltx, header, account_id: bytes, asset, delta: int) -> bool:
     if tl_entry is None:
         return False
     tl = tl_entry.data.value
+    buying, selling = U.trustline_liabilities(tl)
     nb = tl.balance + delta
-    if nb < 0 or nb > tl.limit:
+    if nb < selling or nb > tl.limit - buying:
         return False
     put_trustline(ltx, tl_entry, tl._replace(balance=nb))
     return True
@@ -274,8 +335,6 @@ def convert_with_offers(
     claim_atoms).  Balance effects for the SOURCE side are left to the
     caller; book sellers are debited/credited here.
     """
-    from ..ledger.ledger_txn import entry_to_key
-
     sheep_b = T.Asset.encode(sheep)
     wheat_b = T.Asset.encode(wheat)
     sheep_sent = 0
@@ -300,6 +359,10 @@ def convert_with_offers(
             return (ConvertResult.CROSSED_SELF, sheep_sent,
                     wheat_received, atoms)
 
+        # free the book offer's own reservation before measuring the
+        # seller's capacity (ref crossOfferV10: releaseLiabilities first)
+        apply_offer_liabilities(ltx, oe, -1)
+
         # seller capacity (ref crossOfferV10 :791-792)
         max_wheat_send_offer = min(
             oe.amount, can_sell_at_most(header, ltx, seller_id, wheat))
@@ -308,7 +371,7 @@ def convert_with_offers(
         adjusted = adjust_offer_amount(
             oe.price, max_wheat_send_offer, max_sheep_receive_offer)
         if adjusted == 0:
-            _delete_offer(ltx, entry)
+            _erase_offer(ltx, entry)
             crossed += 1
             continue
 
@@ -338,23 +401,24 @@ def convert_with_offers(
             wheat_received += res.num_wheat_received
 
         if res.wheat_stays:
-            # offer remains: shrink + re-adjust
+            # offer remains: shrink + re-adjust + re-reserve
             new_amount = adjust_offer_amount(
                 oe.price,
                 min(oe.amount - res.num_wheat_received,
                     can_sell_at_most(header, ltx, seller_id, wheat)),
                 can_buy_at_most(header, ltx, seller_id, sheep))
             if new_amount == 0:
-                _delete_offer(ltx, entry)
+                _erase_offer(ltx, entry)
             else:
-                from .operations.base import put_account  # noqa: F401
-
                 oe2 = oe._replace(amount=new_amount)
                 ltx.put(entry._replace(data=T.LedgerEntryData.make(
                     T.LedgerEntryType.OFFER, oe2)))
+                if not apply_offer_liabilities(ltx, oe2, 1):
+                    raise ExchangeError(
+                        "residual offer liabilities do not fit")
             break  # taker exhausted
         else:
-            _delete_offer(ltx, entry)
+            _erase_offer(ltx, entry)
 
     if max_wheat_receive - wheat_received > 0 and \
             max_sheep_send - sheep_sent > 0:
@@ -362,20 +426,37 @@ def convert_with_offers(
     return (ConvertResult.OK, sheep_sent, wheat_received, atoms)
 
 
-def _delete_offer(ltx, entry) -> None:
-    """Remove an offer + its subentry count on the owner
-    (liabilities on resting offers are not tracked separately here; the
-    capacity recomputation above bounds execution)."""
+def _erase_offer(ltx, entry) -> None:
+    """Remove an offer + its reserve accounting (subentry count and any
+    sponsorship).  The offer's liabilities must already have been
+    released."""
     from ..ledger.ledger_txn import entry_to_key
-    from .operations.base import put_account
+    from . import sponsorship as SP
 
-    oe = entry.data.value
-    owner = ltx.load_account(oe.sellerID.value)
     ltx.erase(entry_to_key(entry))
-    if owner is not None:
-        acc = owner.data.value
-        put_account(ltx, owner, acc._replace(
-            numSubEntries=max(0, acc.numSubEntries - 1)))
+    SP.remove_entry_with_possible_sponsorship(
+        ltx, entry, entry.data.value.sellerID.value)
+
+
+def _delete_offer(ltx, entry) -> None:
+    """Release a resting offer's liabilities, then remove it (ref
+    eraseOfferWithPossibleSponsorship after releaseLiabilities)."""
+    apply_offer_liabilities(ltx, entry.data.value, -1)
+    _erase_offer(ltx, entry)
+
+
+def remove_offers_by_account_and_asset(ltx, account_id: bytes,
+                                       asset) -> None:
+    """Delete every offer of the account that buys or sells ``asset``,
+    releasing liabilities and subentry counts (ref
+    removeOffersByAccountAndAsset, TransactionUtils.cpp — run when
+    trustline authorization is fully revoked)."""
+    enc = T.Asset.encode(asset)
+    for entry in ltx.offers_by_account(account_id):
+        o = entry.data.value
+        if T.Asset.encode(o.selling) == enc or \
+                T.Asset.encode(o.buying) == enc:
+            _delete_offer(ltx, entry)
 
 
 # ---------------------------------------------------------------------------

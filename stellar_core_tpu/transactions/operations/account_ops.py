@@ -5,10 +5,34 @@ SetOptionsOpFrame,ChangeTrustOpFrame,AllowTrustOpFrame,
 SetTrustLineFlagsOpFrame,ClawbackOpFrame}.cpp)."""
 from __future__ import annotations
 
+from ...crypto import sha256
 from ...ledger.ledger_txn import entry_to_key
 from ...xdr import types as T
 from .. import utils as U
 from .base import OperationFrame, op_inner, put_account, put_trustline
+
+
+def _revoke_asset_holdings(op_frame, ltx, trustor_id: bytes, asset) -> None:
+    """Full auth revocation side effects: pull the trustor's offers in the
+    asset and redeem pool-share trustlines using it into claimable
+    balances (ref removeOffersAndPoolShareTrustLines)."""
+    from .. import liquidity_pool as LP
+    from ..offer_exchange import remove_offers_by_account_and_asset
+
+    remove_offers_by_account_and_asset(ltx, trustor_id, asset)
+
+    def balance_id_for(pool_id: bytes, withdrawn_asset) -> bytes:
+        # sha256(HashIDPreimage POOL_REVOKE_OP_ID) (ref CAP-38 revoke IDs)
+        et = T.EnvelopeType.ENVELOPE_TYPE_POOL_REVOKE_OP_ID
+        pre = T.HashIDPreimage.make(et, T.HashIDPreimage.arms[et][1].make(
+            sourceAccount=T.account_id(op_frame.tx.source_account_id()),
+            seqNum=op_frame.tx.seq_num(),
+            opNum=op_frame.tx.op_frames.index(op_frame),
+            liquidityPoolID=pool_id,
+            asset=withdrawn_asset))
+        return sha256(T.HashIDPreimage.encode(pre))
+
+    LP.redeem_pool_share_trustlines(ltx, trustor_id, asset, balance_id_for)
 
 OT = T.OperationType
 INT64_MAX = U.INT64_MAX
@@ -476,6 +500,11 @@ class AllowTrustOpFrame(OperationFrame):
                 and new == 0))
         if downgrade and not issuer.flags & T.AUTH_REVOCABLE_FLAG:
             return self._res(C.ALLOW_TRUST_CANT_REVOKE)
+        if new == 0 and cur != 0:
+            _revoke_asset_holdings(self, ltx, self.body.trustor.value,
+                                   asset)
+            tl_entry = ltx.load_trustline(self.body.trustor.value, asset)
+            tl = tl_entry.data.value
         tl = tl._replace(flags=(tl.flags & ~mask) | new)
         _put_trustline(ltx, tl_entry, tl)
         return self._res(C.ALLOW_TRUST_SUCCESS)
@@ -525,6 +554,12 @@ class SetTrustLineFlagsOpFrame(OperationFrame):
         if (flags & T.AUTHORIZED_FLAG
                 and flags & T.AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG):
             return self._res(C.SET_TRUST_LINE_FLAGS_INVALID_STATE)
+        auth_mask = (T.AUTHORIZED_FLAG
+                     | T.AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG)
+        if (tl.flags & auth_mask) and not (flags & auth_mask):
+            _revoke_asset_holdings(self, ltx, b.trustor.value, b.asset)
+            tl_entry = ltx.load_trustline(b.trustor.value, b.asset)
+            tl = tl_entry.data.value
         tl = tl._replace(flags=flags)
         _put_trustline(ltx, tl_entry, tl)
         return self._res(C.SET_TRUST_LINE_FLAGS_SUCCESS)

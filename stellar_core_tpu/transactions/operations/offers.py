@@ -8,12 +8,13 @@ from __future__ import annotations
 from typing import List, Optional
 
 from ...xdr import types as T
+from .. import sponsorship as SP
 from .. import utils as U
 from ..offer_exchange import (
-    ConvertResult, ExchangeError, INT64_MAX, RoundingType, big_divide,
+    ConvertResult, ExchangeError, INT64_MAX, RoundingType,
+    adjust_offer_amount, apply_offer_liabilities, big_divide,
     can_buy_at_most, can_sell_at_most, convert_with_offers,
-    convert_with_offers_and_pools,
-    offer_buying_liabilities, _credit,
+    convert_with_offers_and_pools, _credit,
 )
 from .base import OperationFrame, op_inner, put_account
 
@@ -22,6 +23,20 @@ OT = T.OperationType
 
 def _price_valid(p) -> bool:
     return p.n > 0 and p.d > 0
+
+
+def _zero_offer_entry(src_id: bytes, selling, buying, price, sponsor=None):
+    """0-amount OfferEntry used for up-front reserve bookkeeping: the
+    create-side dummy and remove-side ghost must stay field-identical so
+    sponsorship accounting balances (ref buildOffer(0, 0, ext))."""
+    return U.wrap_entry(
+        T.LedgerEntryType.OFFER,
+        T.OfferEntry.make(
+            sellerID=T.account_id(src_id), offerID=0,
+            selling=selling, buying=buying, amount=0,
+            price=price, flags=0,
+            ext=T.OfferEntry.fields[7][1].make(0)),
+        sponsor=sponsor)
 
 
 def _crosses(book_price, own_price, own_passive: bool,
@@ -85,6 +100,20 @@ class ManageOfferOpFrameBase(OperationFrame):
         src_id = self.source_account_id()
         selling, buying, amount, price, offer_id = self._params()
 
+        if amount == 0:
+            # delete: no trustline prerequisites (ref checkOfferValid:38
+            # "don't bother loading trust lines as we're deleting")
+            existing_entry = ltx.load_offer(src_id, offer_id)
+            if existing_entry is None:
+                return self._res(C["NOT_FOUND"])
+            from ..offer_exchange import _delete_offer
+
+            _delete_offer(ltx, existing_entry)
+            return self._res(0, T.ManageOfferSuccessResult.make(
+                offersClaimed=[],
+                offer=T.ManageOfferSuccessResult.fields[1][1].make(
+                    T.ManageOfferEffect.MANAGE_OFFER_DELETED)))
+
         # trustline prerequisites (ref checkOfferValid)
         if not U.is_native(selling) and \
                 U.asset_issuer(selling) != src_id:
@@ -112,34 +141,54 @@ class ManageOfferOpFrameBase(OperationFrame):
             if existing_entry is None:
                 return self._res(C["NOT_FOUND"])
 
-        if amount == 0:
-            # delete
-            if existing_entry is not None:
-                from ..offer_exchange import _delete_offer
-
-                _delete_offer(ltx, existing_entry)
-            return self._res(0, T.ManageOfferSuccessResult.make(
-                offersClaimed=[],
-                offer=T.ManageOfferSuccessResult.fields[1][1].make(
-                    T.ManageOfferEffect.MANAGE_OFFER_DELETED)))
-
+        offer_sponsor = None
+        existing_flags = None
         if existing_entry is not None:
-            # modify = delete + recreate (frees capacity first)
-            from ..offer_exchange import _delete_offer
+            # modify: release + erase but KEEP the subentry reservation
+            # (ref doApply v14+: "sellSheepOffer is deleted but
+            # sourceAccount is not updated"); the rebuilt offer keeps the
+            # loaded offer's flags and sponsor
+            from ...ledger.ledger_txn import entry_to_key
 
-            _delete_offer(ltx, existing_entry)
+            offer_sponsor = SP.entry_sponsor(existing_entry)
+            existing_flags = existing_entry.data.value.flags
+            apply_offer_liabilities(ltx, existing_entry.data.value, -1)
+            ltx.erase(entry_to_key(existing_entry))
+        else:
+            # new offer: reserve the subentry + check reserve BEFORE
+            # crossing, so capacities and the final liability acquire see
+            # the same minBalance (ref doApply v14+: "establishing the
+            # numSubEntries ... changes" up front, via
+            # createEntryWithPossibleSponsorship on a 0-amount offer)
+            dummy = _zero_offer_entry(src_id, selling, buying, price)
+            res, dummy = SP.create_entry_with_possible_sponsorship(
+                ltx, dummy, src_id)
+            err = SP.map_sponsorship_result(
+                res, self._res(C["LOW_RESERVE"]))
+            if err is not None:
+                return err
+            offer_sponsor = SP.entry_sponsor(dummy)
 
-        # capacity limits for the taker side
+        # the FULL offer's liabilities must fit capacity up front (ref
+        # computeOfferExchangeParameters:151-201: LINE_FULL when the
+        # buying liabilities exceed the available limit, UNDERFUNDED when
+        # the selling liabilities exceed the available balance)
+        from ..offer_exchange import (
+            offer_buying_liabilities, offer_selling_liabilities,
+        )
+
+        if can_buy_at_most(header, ltx, src_id, buying) < \
+                offer_buying_liabilities(price, amount):
+            return self._res(C["LINE_FULL"])
+        if can_sell_at_most(header, ltx, src_id, selling) < \
+                offer_selling_liabilities(price, amount):
+            return self._res(C["UNDERFUNDED"])
+        # crossing limits (ref applyOperationSpecificLimits)
         max_sheep_send = min(
             amount, can_sell_at_most(header, ltx, src_id, selling))
-        if max_sheep_send < amount and \
-                can_sell_at_most(header, ltx, src_id, selling) < amount:
-            return self._res(C["UNDERFUNDED"])
         max_wheat_receive = can_buy_at_most(header, ltx, src_id, buying)
         if self.IS_BUY:
             max_wheat_receive = min(max_wheat_receive, self._buy_amount())
-        if max_wheat_receive == 0:
-            return self._res(C["LINE_FULL"])
 
         own_passive = self.PASSIVE
 
@@ -168,28 +217,29 @@ class ManageOfferOpFrameBase(OperationFrame):
             if not _credit(ltx, header, src_id, buying, wheat_recv):
                 return self._res(C["LINE_FULL"])
 
-        amount_left = amount - sheep_sent
+        # residual resting amount re-adjusted to post-settle capacities
+        # (ref ManageOfferOpFrameBase.cpp:440-456: canSellAtMost /
+        # canBuyAtMost with the operation's own limits applied)
+        sheep_limit = min(amount - sheep_sent,
+                          can_sell_at_most(header, ltx, src_id, selling))
+        wheat_limit = can_buy_at_most(header, ltx, src_id, buying)
         if self.IS_BUY:
-            buy_left = self._buy_amount() - wheat_recv
-            if buy_left <= 0:
-                amount_left = 0
+            wheat_limit = min(wheat_limit,
+                              self._buy_amount() - wheat_recv)
+        amount_left = adjust_offer_amount(price, sheep_limit, wheat_limit)
 
         if amount_left <= 0:
+            # nothing rests: give back the up-front reservation (ref
+            # removeEntryWithPossibleSponsorship on the 0-amount offer)
+            ghost = _zero_offer_entry(src_id, selling, buying, price,
+                                      sponsor=offer_sponsor)
+            SP.remove_entry_with_possible_sponsorship(ltx, ghost, src_id)
             return self._res(0, T.ManageOfferSuccessResult.make(
                 offersClaimed=atoms,
                 offer=T.ManageOfferSuccessResult.fields[1][1].make(
                     T.ManageOfferEffect.MANAGE_OFFER_DELETED)))
 
-        # write the residual resting offer
-        acc_entry = self.load_source_account(ltx)
-        acc = acc_entry.data.value
-        if existing_entry is None:
-            acc2 = acc._replace(numSubEntries=acc.numSubEntries + 1)
-            if acc.balance < U.min_balance(header, acc2):
-                return self._res(C["LOW_RESERVE"])
-            acc = acc2
-        else:
-            acc = acc._replace(numSubEntries=acc.numSubEntries + 1)
+        # write the residual resting offer (subentry already reserved)
         new_id = offer_id
         if existing_entry is None:
             new_id = header.idPool + 1
@@ -201,10 +251,14 @@ class ManageOfferOpFrameBase(OperationFrame):
             buying=buying,
             amount=amount_left,
             price=price,
-            flags=T.PASSIVE_FLAG if self.PASSIVE else 0,
+            flags=(existing_flags if existing_flags is not None
+                   else (T.PASSIVE_FLAG if self.PASSIVE else 0)),
             ext=T.OfferEntry.fields[7][1].make(0))
-        ltx.put(U.wrap_entry(T.LedgerEntryType.OFFER, oe))
-        put_account(ltx, acc_entry, acc)
+        ltx.put(U.wrap_entry(T.LedgerEntryType.OFFER, oe,
+                             sponsor=offer_sponsor))
+        if not apply_offer_liabilities(ltx, oe, 1):
+            # cannot happen: amount_left was adjusted to capacities above
+            raise RuntimeError("resting offer liabilities do not fit")
         effect = (T.ManageOfferEffect.MANAGE_OFFER_CREATED
                   if existing_entry is None
                   else T.ManageOfferEffect.MANAGE_OFFER_UPDATED)

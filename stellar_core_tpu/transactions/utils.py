@@ -120,6 +120,20 @@ def set_seq_info(acc, seq_num: int, ledger_seq: int, close_time: int):
         seqNum=seq_num, ext=T.AccountEntry.fields[9][1].make(1, v1))
 
 
+def set_trustline_liabilities(tl, buying: int, selling: int):
+    """tl with liabilities set (ext v1 created on demand; ref
+    prepareTrustLineEntryExtensionV1)."""
+    if tl.ext.type == 1:
+        v1 = tl.ext.value._replace(
+            liabilities=T.Liabilities.make(buying=buying, selling=selling))
+    else:
+        ext_cls = T.TrustLineEntry.fields[5][1]
+        v1 = ext_cls.arms[1][1].make(
+            liabilities=T.Liabilities.make(buying=buying, selling=selling),
+            ext=ext_cls.arms[1][1].fields[1][1].make(0))
+    return tl._replace(ext=T.TrustLineEntry.fields[5][1].make(1, v1))
+
+
 def set_account_liabilities(acc, buying: int, selling: int):
     acc = _ensure_v3(acc) if acc.ext.type == 0 else acc
     v1 = acc.ext.value._replace(

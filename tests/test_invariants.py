@@ -1,0 +1,316 @@
+"""Invariant checkers: LiabilitiesMatchOffers positive/negative coverage
+(ref src/invariant/LiabilitiesMatchOffers.cpp; the other checkers get
+their coverage from every close in the standalone/sim suites, which run
+with INVARIANT_CHECKS=[".*"])."""
+import pytest
+
+from stellar_core_tpu.invariant.manager import (
+    InvariantDoesNotHold, LiabilitiesMatchOffers,
+)
+from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+from stellar_core_tpu.main import Application, test_config
+from stellar_core_tpu.transactions import utils as U
+from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+from stellar_core_tpu.xdr import types as T
+
+from .test_standalone_node import NodeAccount, root_account
+from .txtest import TestAccount, sha256
+from stellar_core_tpu.crypto import SecretKey
+
+
+@pytest.fixture()
+def app():
+    a = Application(VirtualClock(ClockMode.VIRTUAL_TIME), test_config())
+    a.start()
+    return a
+
+
+def _usd(issuer: bytes):
+    return U.asset_alphanum4(b"USD", issuer)
+
+
+def test_offers_keep_liabilities_in_sync_through_closes(app):
+    """Trust + payment + resting offer + crossing offer all close with
+    LiabilitiesMatchOffers active (it is in the ".*" test config)."""
+    assert any(inv.NAME == LiabilitiesMatchOffers.NAME
+               for inv in app.invariants.invariants)
+    root = root_account(app)
+    issuer = NodeAccount(app, SecretKey(sha256(b"li-issuer")))
+    trader = NodeAccount(app, SecretKey(sha256(b"li-trader")))
+    for acct in (issuer, trader):
+        env = root.tx([root.op_create_account(acct.account_id, 10 ** 10)])
+        assert app.herder.recv_transaction(env) == 0
+        app.herder.manual_close()
+    usd = _usd(issuer.account_id)
+
+    env = trader.tx([trader.op_change_trust(usd)])
+    assert app.herder.recv_transaction(env) == 0
+    app.herder.manual_close()
+    env = issuer.tx([issuer.op_payment(trader.account_id, 5000, usd)])
+    assert app.herder.recv_transaction(env) == 0
+    app.herder.manual_close()
+
+    # resting sell offer: 1000 USD at 2 XLM/USD => selling liabilities
+    # 1000 on the USD trustline, buying 2000 native on the account
+    env = trader.tx([trader.op(
+        T.OperationType.MANAGE_SELL_OFFER,
+        T.ManageSellOfferOp.make(
+            selling=usd, buying=U.asset_native(), amount=1000,
+            price=T.Price.make(n=2, d=1), offerID=0))])
+    assert app.herder.recv_transaction(env) == 0
+    app.herder.manual_close()
+
+    with LedgerTxn(app.ledger_manager.root) as ltx:
+        tl = ltx.load_trustline(trader.account_id, usd)
+        acc = ltx.load_account(trader.account_id)
+        ltx.rollback()
+    assert U.trustline_liabilities(tl.data.value) == (0, 1000)
+    assert U.account_liabilities(acc.data.value) == (2000, 0)
+
+    # root crosses it fully; liabilities drop back to zero
+    env = root.tx([root.op_change_trust(usd), root.op(
+        T.OperationType.MANAGE_BUY_OFFER,
+        T.ManageBuyOfferOp.make(
+            selling=U.asset_native(), buying=usd, buyAmount=1000,
+            price=T.Price.make(n=2, d=1), offerID=0))])
+    assert app.herder.recv_transaction(env) == 0
+    app.herder.manual_close()
+    with LedgerTxn(app.ledger_manager.root) as ltx:
+        tl = ltx.load_trustline(trader.account_id, usd)
+        acc = ltx.load_account(trader.account_id)
+        ltx.rollback()
+    assert U.trustline_liabilities(tl.data.value) == (0, 0)
+    assert U.account_liabilities(acc.data.value) == (0, 0)
+
+
+def test_full_revocation_pulls_offers(app):
+    """Revoking trustline auth deletes the trustor's offers in the asset
+    and releases their liabilities (ref removeOffersByAccountAndAsset)."""
+    root = root_account(app)
+    issuer = NodeAccount(app, SecretKey(sha256(b"rv-issuer")))
+    trader = NodeAccount(app, SecretKey(sha256(b"rv-trader")))
+    for acct in (issuer, trader):
+        env = root.tx([root.op_create_account(acct.account_id, 10 ** 10)])
+        assert app.herder.recv_transaction(env) == 0
+        app.herder.manual_close()
+    # issuer requires+may revoke auth
+    env = issuer.tx([issuer.op_set_options(
+        set_flags=T.AUTH_REQUIRED_FLAG | T.AUTH_REVOCABLE_FLAG)])
+    assert app.herder.recv_transaction(env) == 0
+    app.herder.manual_close()
+    usd = _usd(issuer.account_id)
+    env = trader.tx([trader.op_change_trust(usd)])
+    assert app.herder.recv_transaction(env) == 0
+    app.herder.manual_close()
+    env = issuer.tx([issuer.op(
+        T.OperationType.SET_TRUST_LINE_FLAGS,
+        T.SetTrustLineFlagsOp.make(
+            trustor=T.account_id(trader.account_id), asset=usd,
+            clearFlags=0, setFlags=T.AUTHORIZED_FLAG)),
+        issuer.op_payment(trader.account_id, 5000, usd)])
+    assert app.herder.recv_transaction(env) == 0
+    app.herder.manual_close()
+    env = trader.tx([trader.op(
+        T.OperationType.MANAGE_SELL_OFFER,
+        T.ManageSellOfferOp.make(
+            selling=usd, buying=U.asset_native(), amount=1000,
+            price=T.Price.make(n=1, d=1), offerID=0))])
+    assert app.herder.recv_transaction(env) == 0
+    app.herder.manual_close()
+
+    env = issuer.tx([issuer.op(
+        T.OperationType.SET_TRUST_LINE_FLAGS,
+        T.SetTrustLineFlagsOp.make(
+            trustor=T.account_id(trader.account_id), asset=usd,
+            clearFlags=T.AUTHORIZED_FLAG, setFlags=0))])
+    assert app.herder.recv_transaction(env) == 0
+    app.herder.manual_close()
+
+    with LedgerTxn(app.ledger_manager.root) as ltx:
+        offers = ltx.offers_by_account(trader.account_id)
+        tl = ltx.load_trustline(trader.account_id, usd)
+        acc = ltx.load_account(trader.account_id)
+        ltx.rollback()
+    assert offers == []
+    assert U.trustline_liabilities(tl.data.value) == (0, 0)
+    assert U.account_liabilities(acc.data.value) == (0, 0)
+    assert acc.data.value.numSubEntries == 1  # trustline only
+
+
+def test_revocation_redeems_pool_shares(app):
+    """Revoking auth on an asset redeems pool-share trustlines using it
+    into unconditional claimable balances (ref CAP-38
+    removeOffersAndPoolShareTrustLines)."""
+    import stellar_core_tpu.transactions.liquidity_pool as LP
+
+    root = root_account(app)
+    issuer = NodeAccount(app, SecretKey(sha256(b"ps-issuer")))
+    trader = NodeAccount(app, SecretKey(sha256(b"ps-trader")))
+    for acct in (issuer, trader):
+        env = root.tx([root.op_create_account(acct.account_id, 10 ** 10)])
+        assert app.herder.recv_transaction(env) == 0
+        app.herder.manual_close()
+    env = issuer.tx([issuer.op_set_options(
+        set_flags=T.AUTH_REQUIRED_FLAG | T.AUTH_REVOCABLE_FLAG)])
+    assert app.herder.recv_transaction(env) == 0
+    app.herder.manual_close()
+    usd = _usd(issuer.account_id)
+    native = U.asset_native()
+    env = trader.tx([trader.op_change_trust(usd)])
+    assert app.herder.recv_transaction(env) == 0
+    app.herder.manual_close()
+    env = issuer.tx([issuer.op(
+        T.OperationType.SET_TRUST_LINE_FLAGS,
+        T.SetTrustLineFlagsOp.make(
+            trustor=T.account_id(trader.account_id), asset=usd,
+            clearFlags=0, setFlags=T.AUTHORIZED_FLAG)),
+        issuer.op_payment(trader.account_id, 100000, usd)])
+    assert app.herder.recv_transaction(env) == 0
+    app.herder.manual_close()
+    env = trader.tx([trader.op_change_trust_pool(native, usd)])
+    assert app.herder.recv_transaction(env) == 0
+    app.herder.manual_close()
+    params = T.LiquidityPoolParameters.make(
+        T.LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT,
+        T.LiquidityPoolConstantProductParameters.make(
+            assetA=native, assetB=usd, fee=T.LIQUIDITY_POOL_FEE_V18))
+    pool_id = LP.pool_id_from_params(params)
+    env = trader.tx([trader.op_pool_deposit(pool_id, 40000, 20000)])
+    assert app.herder.recv_transaction(env) == 0
+    app.herder.manual_close()
+
+    env = issuer.tx([issuer.op(
+        T.OperationType.SET_TRUST_LINE_FLAGS,
+        T.SetTrustLineFlagsOp.make(
+            trustor=T.account_id(trader.account_id), asset=usd,
+            clearFlags=T.AUTHORIZED_FLAG, setFlags=0))])
+    assert app.herder.recv_transaction(env) == 0
+    app.herder.manual_close()
+
+    with LedgerTxn(app.ledger_manager.root) as ltx:
+        ps_tl = LP.load_pool_share_trustline(
+            ltx, trader.account_id, pool_id)
+        pool = LP.load_pool(ltx, pool_id)
+        cbs = [e for e in ltx.entries_by_key_prefix(
+            T.LedgerEntryType.encode(T.LedgerEntryType.CLAIMABLE_BALANCE))
+            if e.data.value.claimants[0].value.destination.value
+            == trader.account_id]
+        usd_tl = ltx.load_trustline(trader.account_id, usd)
+        ltx.rollback()
+    assert ps_tl is None           # pool-share trustline redeemed
+    assert pool is None            # sole participant -> pool deleted
+    assert len(cbs) == 2           # one claimable balance per pool asset
+    amounts = sorted((T.Asset.encode(e.data.value.asset) ==
+                      T.Asset.encode(usd), e.data.value.amount)
+                     for e in cbs)
+    assert amounts[0][1] == 40000  # native side
+    assert amounts[1][1] == 20000  # USD side
+    assert LP.tl_pool_use_count(usd_tl.data.value) == 0
+
+
+def test_native_sell_offer_capped_to_post_reserve_capacity(app):
+    """Selling more native than is spendable rests a capped offer whose
+    liabilities respect the reserve that the offer itself consumes
+    (ref doApply v14+ up-front subentry reservation)."""
+    root = root_account(app)
+    issuer = NodeAccount(app, SecretKey(sha256(b"cap-issuer")))
+    seller = NodeAccount(app, SecretKey(sha256(b"cap-seller")))
+    base_reserve = app.ledger_manager.last_closed_header().baseReserve
+    # seller: 2 base reserves (account) + 1 (trustline) + 1 (offer) + fees
+    funding = base_reserve * 4 + 10 ** 7
+    for acct, amt in ((issuer, 10 ** 10), (seller, funding)):
+        env = root.tx([root.op_create_account(acct.account_id, amt)])
+        assert app.herder.recv_transaction(env) == 0
+        app.herder.manual_close()
+    usd = _usd(issuer.account_id)
+    env = seller.tx([seller.op_change_trust(usd)])
+    assert app.herder.recv_transaction(env) == 0
+    app.herder.manual_close()
+
+    # oversized: the full offer's selling liabilities exceed the
+    # available balance (incl. the offer's own reserve) -> UNDERFUNDED
+    env = seller.tx([seller.op(
+        T.OperationType.MANAGE_SELL_OFFER,
+        T.ManageSellOfferOp.make(
+            selling=U.asset_native(), buying=usd,
+            amount=funding,
+            price=T.Price.make(n=1, d=1), offerID=0))])
+    assert app.herder.recv_transaction(env) == 0
+    app.herder.manual_close()
+    tp = app._meta_stream[-1].value.txProcessing[0]
+    opres = tp.result.result.result.value[0]
+    code = opres.value.value.type
+    assert code == (T.ManageSellOfferResultCode
+                    .MANAGE_SELL_OFFER_UNDERFUNDED)
+
+    # exactly-fitting: spendable balance after the offer's own reserve
+    with LedgerTxn(app.ledger_manager.root) as ltx:
+        acc = ltx.load_account(seller.account_id)
+        hdr = ltx.header()
+        ltx.rollback()
+    acc_v = acc.data.value
+    spendable = acc_v.balance - U.min_balance(
+        hdr, acc_v._replace(numSubEntries=acc_v.numSubEntries + 1))
+    env = seller.tx([seller.op(
+        T.OperationType.MANAGE_SELL_OFFER,
+        T.ManageSellOfferOp.make(
+            selling=U.asset_native(), buying=usd,
+            amount=spendable - 100,  # leave room for this tx's fee
+            price=T.Price.make(n=1, d=1), offerID=0))])
+    assert app.herder.recv_transaction(env) == 0
+    app.herder.manual_close()
+    with LedgerTxn(app.ledger_manager.root) as ltx:
+        offers = ltx.offers_by_account(seller.account_id)
+        acc = ltx.load_account(seller.account_id)
+        hdr = ltx.header()
+        ltx.rollback()
+    assert len(offers) == 1
+    acc_v = acc.data.value
+    _, selling = U.account_liabilities(acc_v)
+    assert selling == offers[0].data.value.amount > 0
+    # balance covers reserve (incl. the offer subentry) + liabilities
+    assert acc_v.balance - selling >= U.min_balance(hdr, acc_v)
+
+
+def test_liabilities_desync_is_caught(app):
+    """Hand-inject an offer without liability bookkeeping: the checker
+    must report the drift."""
+    root = root_account(app)
+    with LedgerTxn(app.ledger_manager.root) as ltx:
+        acc_entry = ltx.load_account(root.account_id)
+        acc = acc_entry.data.value
+        offer = U.wrap_entry(
+            T.LedgerEntryType.OFFER,
+            T.OfferEntry.make(
+                sellerID=T.account_id(root.account_id),
+                offerID=991,
+                selling=U.asset_native(),
+                buying=_usd(root.account_id),
+                amount=500,
+                price=T.Price.make(n=1, d=1),
+                flags=0,
+                ext=T.OfferEntry.fields[7][1].make(0)))
+        ltx.put(offer)
+        msg = LiabilitiesMatchOffers().check_on_tx_apply(ltx, None, True)
+        ltx.rollback()
+    assert "out of sync" in msg
+
+
+def test_unauthorized_trustline_with_liabilities_is_caught(app):
+    root = root_account(app)
+    issuer = SecretKey(sha256(b"li-auth-issuer")).public_key().raw
+    usd = _usd(issuer)
+    with LedgerTxn(app.ledger_manager.root) as ltx:
+        tl_val = T.TrustLineEntry.make(
+            accountID=T.account_id(root.account_id),
+            asset=T.TrustLineAsset.make(usd.type, usd.value),
+            balance=100,
+            limit=10 ** 9,
+            flags=0,  # NOT authorized
+            ext=T.TrustLineEntry.fields[5][1].make(0))
+        tl_val = U.set_trustline_liabilities(tl_val, 10, 0)
+        tl = U.wrap_entry(T.LedgerEntryType.TRUSTLINE, tl_val)
+        ltx.put(tl)
+        msg = LiabilitiesMatchOffers().check_on_tx_apply(ltx, None, True)
+        ltx.rollback()
+    assert "unauthorized" in msg
