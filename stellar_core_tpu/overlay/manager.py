@@ -116,6 +116,13 @@ class OverlayManager:
         addr = getattr(peer, "remote_addr", None)
         if addr is not None and self.peer_manager is not None:
             self.peer_manager.on_connect_success(*addr)
+        # pull the peer's current consensus state immediately: without
+        # this, a node whose first nomination fired before the connection
+        # authenticated would never hear it and both sides could sit
+        # silent forever (ref Peer.cpp sending GET_SCP_STATE on auth)
+        seq = self.app.ledger_manager.last_closed_seq()
+        peer.send_message(O.StellarMessage.make(
+            O.MessageType.GET_SCP_STATE, seq))
 
     def peer_closed(self, peer, reason: str) -> None:
         if peer in self.pending_peers:

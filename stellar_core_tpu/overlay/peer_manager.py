@@ -45,9 +45,12 @@ class PeerManager:
 
     def ensure_exists(self, host: str, port: int,
                       ptype: int = OUTBOUND) -> None:
+        # a known address can be promoted (e.g. OUTBOUND -> PREFERRED
+        # after a config change) but never silently demoted
         self.app.database.execute(
             "INSERT INTO peers(host, port, type) VALUES(?,?,?) "
-            "ON CONFLICT(host, port) DO NOTHING", (host, port, ptype))
+            "ON CONFLICT(host, port) DO UPDATE SET "
+            "type=MAX(type, excluded.type)", (host, port, ptype))
         self.app.database.commit()
 
     def on_connect_success(self, host: str, port: int) -> None:
@@ -64,7 +67,10 @@ class PeerManager:
             "SELECT numfailures FROM peers WHERE host=? AND port=?",
             (host, port)).fetchone()
         failures = (row[0] if row else 0) + 1
-        backoff = BACKOFF_BASE_SECONDS * (2 ** min(failures, 8))
+        # quick first retries (a dial racing the peer's listener coming
+        # up is normal at boot), exponential after, capped exponent
+        backoff = min(2.0 * (4 ** min(failures - 1, 8)),
+                      BACKOFF_BASE_SECONDS * 256)
         self.app.database.execute(
             "INSERT INTO peers(host, port, numfailures, nextattempt) "
             "VALUES(?,?,?,?) ON CONFLICT(host, port) DO UPDATE SET "

@@ -60,6 +60,7 @@ def test_two_processes_converge_and_accept_tx(tmp_path):
     http_ports = [_free_port(), _free_port()]
 
     procs = []
+    logs = []
     for i in range(2):
         conf = tmp_path / f"node{i}.toml"
         validators = "".join(
@@ -80,11 +81,14 @@ threshold = 2
 validators = [{validators}]
 """)
         env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        # log to files, never PIPE: an unread pipe fills at ~64KB and
+        # blocks the node mid-write, freezing consensus
+        log = open(tmp_path / f"node{i}.log", "w")
+        logs.append(log)
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "stellar_core_tpu",
              "--conf", str(conf), "run"],
-            cwd=REPO, env=env, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT))
+            cwd=REPO, env=env, stdout=log, stderr=subprocess.STDOUT))
     try:
         for port in http_ports:
             _wait_http(port)
@@ -173,3 +177,5 @@ validators = [{validators}]
                 p.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 p.kill()
+        for log in logs:
+            log.close()
