@@ -128,6 +128,7 @@ class ApplyBucketsWork(BasicWork):
         db.execute("DELETE FROM offers")
         db.execute("DELETE FROM ledgerheaders")
         db.commit()
+        app.ledger_manager.root.clear_entry_cache()
         with LedgerTxn(app.ledger_manager.root) as ltx:
             ltx.set_header(header)
             ltx.commit()
@@ -333,8 +334,11 @@ class CatchupManager:
         if anchor is None:
             return  # wait for the buffer (or the next checkpoint) to align
         trusted_hash = anchor[0].previous_ledger_hash
+        mode = (CatchupConfiguration.COMPLETE
+                if app.config.CATCHUP_COMPLETE
+                else CatchupConfiguration.MINIMAL)
         work = CatchupWork(app, archive,
-                           CatchupConfiguration(target_cp),
+                           CatchupConfiguration(target_cp, mode),
                            trusted_hash=trusted_hash)
         # crank the work directly to completion (catchup blocks applying;
         # cranking the app-wide scheduler could re-enter other works)

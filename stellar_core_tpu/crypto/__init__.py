@@ -6,7 +6,9 @@ boundary: 100%% of tx-signature verification routes through
 :func:`ed25519.verify_sig` (mirrors PubKeyUtils::verifySig,
 ref src/crypto/SecretKey.cpp:428).
 """
-from .sha import sha256, SHA256, hmac_sha256, hkdf_extract, hkdf_expand  # noqa: F401
+from .sha import (  # noqa: F401
+    sha256, SHA256, blake2, hmac_sha256, hkdf_extract, hkdf_expand,
+)
 from .ed25519 import SecretKey, PublicKey, verify_sig, sign  # noqa: F401
 from .strkey import (  # noqa: F401
     encode_ed25519_public_key,

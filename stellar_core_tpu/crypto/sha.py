@@ -24,6 +24,11 @@ class SHA256:
         return self._h.digest()
 
 
+def blake2(data: bytes) -> bytes:
+    """One-shot 32-byte BLAKE2b (ref: src/crypto/BLAKE2.h blake2())."""
+    return hashlib.blake2b(data, digest_size=32).digest()
+
+
 def hmac_sha256(key: bytes, data: bytes) -> bytes:
     return _hmac.new(key, data, hashlib.sha256).digest()
 

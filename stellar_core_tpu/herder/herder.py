@@ -140,7 +140,8 @@ class HerderSCPDriver(SCPDriver):
         self.herder._scp_timers[key] = t
 
     def compute_timeout(self, round_number, is_nomination) -> float:
-        return float(min(round_number + 1, MAX_SCP_TIMEOUT_SECONDS))
+        return float(min(round_number + 1,
+                         self.app.config.MAX_SCP_TIMEOUT_SECONDS))
 
     # -- externalization ---------------------------------------------------
 
@@ -444,7 +445,9 @@ class Herder:
         lm = self.app.ledger_manager
         self.tx_queue.shift(lm.root)
         self.scp.purge_slots(
-            max(0, slot_index - SCP_EXTRA_LOOKBACK_LEDGERS), slot_index)
+            max(0, slot_index - max(SCP_EXTRA_LOOKBACK_LEDGERS,
+                                    self.app.config.MAX_SLOTS_TO_REMEMBER)),
+            slot_index)
 
     def check_quorum_intersection(self, qmap=None):
         """Run the quorum-intersection checker over the tracked network

@@ -141,6 +141,14 @@ class LedgerManager:
 
             apply_order = tx_set.txs_in_apply_order()
 
+            # bulk-load the entries this set will touch before the apply
+            # loops go key-by-key (ref LedgerTxnRoot::prefetch fed by
+            # insertKeysForFeeProcessing/insertLedgerKeysToPrefetch)
+            prefetch_keys: set = set()
+            for frame in apply_order:
+                prefetch_keys.update(frame.keys_to_prefetch())
+            self.root.prefetch(prefetch_keys)
+
             # phase 0: batched signature verification on device (P5)
             verdicts = tx_set.prevalidate_signatures(
                 use_device=self.app.config.CRYPTO_BACKEND == "tpu")

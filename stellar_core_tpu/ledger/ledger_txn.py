@@ -34,6 +34,24 @@ class LedgerTxnError(Exception):
 
 VIRTUAL_PREFIX = b"\xff"
 
+_CACHE_MISS = object()  # sentinel: None is a valid (negative) cache value
+
+
+def account_key(account_id: bytes):
+    """LedgerKey for an account (the one place the key layout lives)."""
+    return T.LedgerKey.make(
+        T.LedgerEntryType.ACCOUNT,
+        T.LedgerKey.arms[T.LedgerEntryType.ACCOUNT][1].make(
+            accountID=T.account_id(account_id)))
+
+
+def trustline_key(account_id: bytes, asset):
+    """LedgerKey for a trustline; asset is a TrustLineAsset."""
+    return T.LedgerKey.make(
+        T.LedgerEntryType.TRUSTLINE,
+        T.LedgerKey.arms[T.LedgerEntryType.TRUSTLINE][1].make(
+            accountID=T.account_id(account_id), asset=asset))
+
 
 def sponsorship_key(sponsored_id: bytes) -> bytes:
     return b"\xffSP" + sponsored_id
@@ -88,18 +106,10 @@ class AbstractLedgerTxn:
         return self.get(key_bytes(key))
 
     def load_account(self, account_id: bytes):
-        k = T.LedgerKey.make(
-            T.LedgerEntryType.ACCOUNT,
-            T.LedgerKey.arms[T.LedgerEntryType.ACCOUNT][1].make(
-                accountID=T.account_id(account_id)))
-        return self.load(k)
+        return self.load(account_key(account_id))
 
     def load_trustline(self, account_id: bytes, asset):
-        k = T.LedgerKey.make(
-            T.LedgerEntryType.TRUSTLINE,
-            T.LedgerKey.arms[T.LedgerEntryType.TRUSTLINE][1].make(
-                accountID=T.account_id(account_id), asset=asset))
-        return self.load(k)
+        return self.load(trustline_key(account_id, asset))
 
     def load_offer(self, seller_id: bytes, offer_id: int):
         k = T.LedgerKey.make(
@@ -337,20 +347,73 @@ class LedgerTxnRoot(AbstractLedgerTxn):
     with the per-type SQL adapters collapsed into a keyed store + an offers
     index for order-book scans — SURVEY.md §2.4/§2.11)."""
 
+    ENTRY_CACHE_SIZE = 8192
+
     def __init__(self, db):
         self.db = db
         self._child: Optional[LedgerTxn] = None
         self._header_cache = None
+        # decoded-entry cache incl. negative results (ref LedgerTxnRoot's
+        # EntryCache + prefetch machinery, LedgerTxnImpl.h); entries are
+        # immutable namedtuples so sharing decoded objects is safe
+        from collections import OrderedDict
+
+        self._entry_cache: "OrderedDict[bytes, Optional[object]]" = \
+            OrderedDict()
+        self.cache_hits = 0
+        self.cache_misses = 0
 
     # -- reads -------------------------------------------------------------
 
+    def _cache_put(self, kb: bytes, entry) -> None:
+        c = self._entry_cache
+        c[kb] = entry
+        c.move_to_end(kb)
+        while len(c) > self.ENTRY_CACHE_SIZE:
+            c.popitem(last=False)
+
+    def clear_entry_cache(self) -> None:
+        """Required after any write that bypasses _commit_from_child
+        (bucket-apply catchup wiping the SQL store)."""
+        self._entry_cache.clear()
+
+    def prefetch(self, kbs) -> int:
+        """Bulk-load entries into the cache ahead of an apply loop (ref
+        LedgerTxnRoot::prefetch).  Returns the number of keys newly
+        cached (positive or negative)."""
+        missing = [kb for kb in kbs if kb not in self._entry_cache]
+        n = 0
+        for i in range(0, len(missing), 500):
+            chunk = missing[i:i + 500]
+            marks = ",".join("?" * len(chunk))
+            found = dict(self.db.execute(
+                f"SELECT key, entry FROM ledgerentries "
+                f"WHERE key IN ({marks})", chunk))
+            for kb in chunk:
+                blob = found.get(kb)
+                self._cache_put(
+                    kb, T.LedgerEntry.decode(blob)
+                    if blob is not None else None)
+                n += 1
+        return n
+
+    def prefetch_hit_rate(self) -> float:
+        total = self.cache_hits + self.cache_misses
+        return self.cache_hits / total if total else 0.0
+
     def get(self, kb: bytes):
+        cached = self._entry_cache.get(kb, _CACHE_MISS)
+        if cached is not _CACHE_MISS:
+            self.cache_hits += 1
+            self._entry_cache.move_to_end(kb)
+            return cached
+        self.cache_misses += 1
         row = self.db.execute(
             "SELECT entry FROM ledgerentries WHERE key = ?", (kb,)
         ).fetchone()
-        if row is None:
-            return None
-        return T.LedgerEntry.decode(row[0])
+        entry = T.LedgerEntry.decode(row[0]) if row is not None else None
+        self._cache_put(kb, entry)
+        return entry
 
     def header(self):
         if self._header_cache is None:
@@ -374,6 +437,7 @@ class LedgerTxnRoot(AbstractLedgerTxn):
                         "live virtual entry at root commit (unclosed "
                         "sponsorship)")
                 continue
+            self._cache_put(kb, entry)  # write-through (None = deleted)
             if entry is None:
                 cur.execute("DELETE FROM ledgerentries WHERE key = ?", (kb,))
                 cur.execute("DELETE FROM offers WHERE key = ?", (kb,))

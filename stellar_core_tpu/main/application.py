@@ -46,7 +46,8 @@ class Application:
         self.catchup_manager = CatchupManager(self)
         from ..process import ProcessManager
 
-        self.process_manager = ProcessManager(self)
+        self.process_manager = ProcessManager(
+            self, config.MAX_CONCURRENT_SUBPROCESSES)
         self._meta_stream: List = []
         self._started = False
         # real-socket mode (enable_tcp): io service + listeners
@@ -63,6 +64,7 @@ class Application:
                    config or Config())
 
     def start(self) -> None:
+        self.config.validate()
         if self.ledger_manager.load_last_known_ledger():
             self._restore_bucket_state()
         else:
@@ -72,6 +74,13 @@ class Application:
             self.overlay_manager.start()
         if self.tcp_io is not None:
             self.connect_known_peers()
+            # periodic connection top-up (ref OverlayManagerImpl::tick):
+            # a one-shot dial would leave the node isolated forever when
+            # it races a peer's listener coming up
+            from ..utils.clock import VirtualTimer
+
+            self._overlay_tick_timer = VirtualTimer(self.clock)
+            self._arm_overlay_tick()
         self.history_manager.publish_queued_history()
         self._started = True
 
@@ -132,22 +141,51 @@ class Application:
     def connect_known_peers(self) -> None:
         from ..overlay.tcp_peer import connect_to
 
+        from ..overlay.peer_manager import OUTBOUND, PREFERRED
+
         pm = self.overlay_manager.peer_manager
         known = []
-        for addr in self.config.KNOWN_PEERS:
-            host, _, port = addr.partition(":")
-            known.append((host or "127.0.0.1", int(port or 11625)))
+        for plist, ptype in ((self.config.PREFERRED_PEERS, PREFERRED),
+                             (self.config.KNOWN_PEERS, OUTBOUND)):
+            for addr in plist:
+                host, _, port = addr.partition(":")
+                known.append((host or "127.0.0.1", int(port or 11625),
+                              ptype))
         if pm is not None:
-            for host, port in known:
-                pm.ensure_exists(host, port)
+            for host, port, ptype in known:
+                pm.ensure_exists(host, port, ptype)
             targets = pm.peers_to_try(
                 self.config.TARGET_PEER_CONNECTIONS)
         else:
-            targets = known
+            targets = [(h, p) for h, p, _ in known]
+        # never re-dial an address we're already connected (or mid-
+        # handshake) to — the periodic tick would otherwise churn a new
+        # socket to the same peer every 2s
+        connected = set()
+        for p in list(self.overlay_manager.authenticated.values()) + \
+                list(self.overlay_manager.pending_peers):
+            addr = getattr(p, "remote_addr", None)
+            if addr is not None:
+                connected.add(addr)
         for host, port in targets:
+            if (host, port) in connected:
+                continue
             peer = connect_to(self, host, port)
             if peer is None and pm is not None:
                 pm.on_connect_failure(host, port)
+
+    def _arm_overlay_tick(self) -> None:
+        t = self._overlay_tick_timer
+        t.cancel()
+        t.expires_from_now(2.0)
+        t.async_wait(self._overlay_tick)
+
+    def _overlay_tick(self) -> None:
+        om = self.overlay_manager
+        if om is not None and \
+                len(om.authenticated) < self.config.TARGET_PEER_CONNECTIONS:
+            self.connect_known_peers()
+        self._arm_overlay_tick()
 
     def graceful_stop(self) -> None:
         self.process_manager.shutdown()

@@ -1,12 +1,21 @@
 """Config: the node's knob surface (ref src/main/Config.h — a 607-line
 header of ~200 TOML-loaded fields; this port keeps the same names for the
 load-bearing ones and loads from TOML via tomllib or from kwargs).
+
+Like the reference's Config::load, ``from_toml`` rejects unknown keys and
+``validate()`` runs the sanity pass (quorum safety incl. FAILURE_SAFETY /
+UNSAFE_QUORUM, port/time ranges, regex compilation) before a node boots.
 """
 from __future__ import annotations
 
 from typing import Dict, List, Optional
 
 from ..crypto import SecretKey, sha256
+
+
+class ConfigError(Exception):
+    """Invalid node configuration (ref std::invalid_argument throws from
+    Config::load/validateConfig)."""
 
 
 class Config:
@@ -68,13 +77,27 @@ class Config:
         # the host oracle asserting equality — differential testing)
         self.SCP_TALLY_BACKEND: str = kw.get("SCP_TALLY_BACKEND", "host")
 
+        # quorum safety (ref Config.h FAILURE_SAFETY / UNSAFE_QUORUM:
+        # -1 = auto-derive f from the top-level quorum set size)
+        self.FAILURE_SAFETY: int = kw.get("FAILURE_SAFETY", -1)
+        self.UNSAFE_QUORUM: bool = kw.get("UNSAFE_QUORUM", False)
+
         # consensus cadence (ref Herder.cpp:7-18)
         self.EXP_LEDGER_TIMESPAN_SECONDS: float = kw.get(
             "EXP_LEDGER_TIMESPAN_SECONDS",
             1.0 if kw.get("ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING")
             else 5.0)
-        self.MAX_SCP_TIMEOUT_SECONDS: float = 240.0
-        self.CONSENSUS_STUCK_TIMEOUT_SECONDS: float = 35.0
+        self.MAX_SCP_TIMEOUT_SECONDS: float = kw.get(
+            "MAX_SCP_TIMEOUT_SECONDS", 240.0)
+        self.CONSENSUS_STUCK_TIMEOUT_SECONDS: float = kw.get(
+            "CONSENSUS_STUCK_TIMEOUT_SECONDS", 35.0)
+        # closed-slot retention for SCP state (ref MAX_SLOTS_TO_REMEMBER)
+        self.MAX_SLOTS_TO_REMEMBER: int = kw.get(
+            "MAX_SLOTS_TO_REMEMBER", 12)
+
+        # catchup (ref CATCHUP_COMPLETE: replay every ledger instead of
+        # assuming bucket state at the anchor checkpoint)
+        self.CATCHUP_COMPLETE: bool = kw.get("CATCHUP_COMPLETE", False)
 
         # overlay
         self.PEER_PORT: int = kw.get("PEER_PORT", 11625)
@@ -84,6 +107,13 @@ class Config:
         self.MAX_ADDITIONAL_PEER_CONNECTIONS: int = kw.get(
             "MAX_ADDITIONAL_PEER_CONNECTIONS", 64)
         self.KNOWN_PEERS: List[str] = kw.get("KNOWN_PEERS", [])
+        # always-reconnect peers, tried before KNOWN_PEERS (ref
+        # PREFERRED_PEERS)
+        self.PREFERRED_PEERS: List[str] = kw.get("PREFERRED_PEERS", [])
+
+        # work/process subsystem (ref MAX_CONCURRENT_SUBPROCESSES)
+        self.MAX_CONCURRENT_SUBPROCESSES: int = kw.get(
+            "MAX_CONCURRENT_SUBPROCESSES", 16)
 
         # device tier
         self.CRYPTO_BACKEND: str = kw.get("CRYPTO_BACKEND", "cpu")
@@ -93,11 +123,93 @@ class Config:
 
         # history
         self.HISTORY: Dict[str, dict] = kw.get("HISTORY", {})
-        self.CHECKPOINT_FREQUENCY: int = (
+        self.CHECKPOINT_FREQUENCY: int = kw.get(
+            "CHECKPOINT_FREQUENCY",
             8 if self.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING else 64)
 
         if self.NODE_SEED is None:
             self.NODE_SEED = sha256(b"default-node-seed")
+
+    def validate(self) -> None:
+        """Sanity pass run before a node boots (ref Config::load's
+        validation + validateConfig's quorum-safety rules).  Raises
+        ConfigError with an operator-actionable message."""
+        import re
+
+        if not self.NETWORK_PASSPHRASE:
+            raise ConfigError("NETWORK_PASSPHRASE must be non-empty")
+        if len(self.NODE_SEED) != 32:
+            raise ConfigError("NODE_SEED must be a 32-byte seed")
+        # 0 / None disable the respective listener (enable_tcp honors
+        # both sentinels); ranges only apply when enabled
+        for name in ("PEER_PORT", "HTTP_PORT"):
+            v = getattr(self, name)
+            if v and not (0 < v < 65536):
+                raise ConfigError(f"{name} out of range: {v}")
+        if self.PEER_PORT and self.HTTP_PORT and \
+                self.PEER_PORT == self.HTTP_PORT:
+            raise ConfigError("PEER_PORT and HTTP_PORT must differ")
+        if self.EXP_LEDGER_TIMESPAN_SECONDS <= 0:
+            raise ConfigError("EXP_LEDGER_TIMESPAN_SECONDS must be > 0")
+        if self.MAX_SLOTS_TO_REMEMBER < 1:
+            raise ConfigError("MAX_SLOTS_TO_REMEMBER must be >= 1")
+        if self.MAX_CONCURRENT_SUBPROCESSES < 1:
+            raise ConfigError("MAX_CONCURRENT_SUBPROCESSES must be >= 1")
+        if self.CRYPTO_BACKEND not in ("cpu", "tpu"):
+            raise ConfigError(
+                f"unknown CRYPTO_BACKEND {self.CRYPTO_BACKEND!r}")
+        if self.SCP_TALLY_BACKEND not in ("host", "tensor", "both"):
+            raise ConfigError(
+                f"unknown SCP_TALLY_BACKEND {self.SCP_TALLY_BACKEND!r}")
+        for pat in self.INVARIANT_CHECKS:
+            try:
+                re.compile(pat)
+            except re.error as e:
+                raise ConfigError(
+                    f"INVARIANT_CHECKS pattern {pat!r}: {e}") from e
+        for a in self.HISTORY_ARCHIVES:
+            if len(a) != 2:
+                raise ConfigError(
+                    "HISTORY_ARCHIVES entries must be [name, path] pairs")
+        if self.QUORUM_SET is not None:
+            self._validate_qset(self.QUORUM_SET, depth=0)
+        elif self.NODE_IS_VALIDATOR and not self.RUN_STANDALONE:
+            raise ConfigError("validator nodes need a QUORUM_SET")
+
+    def _validate_qset(self, qs: dict, depth: int) -> None:
+        """Structure + byzantine-safety of a quorum-set spec (ref
+        validateConfig: threshold >= n - f with f = (n-1)/3 unless
+        UNSAFE_QUORUM; FAILURE_SAFETY overrides f at the top level)."""
+        if depth > 2:
+            raise ConfigError("quorum set nested deeper than 2 levels")
+        validators = qs.get("validators", [])
+        inner = qs.get("inner_sets", [])
+        n = len(validators) + len(inner)
+        thr = qs.get("threshold", 0)
+        if n == 0:
+            raise ConfigError("empty quorum set")
+        if not (1 <= thr <= n):
+            raise ConfigError(
+                f"quorum threshold {thr} out of range 1..{n}")
+        if len(set(validators)) != len(validators):
+            raise ConfigError("duplicate validator in quorum set")
+        if depth == 0 and not self.UNSAFE_QUORUM:
+            max_f = (n - 1) // 3
+            f = max_f if self.FAILURE_SAFETY < 0 else self.FAILURE_SAFETY
+            if f > max_f:
+                # tolerating more than (n-1)/3 byzantine failures is
+                # impossible; a larger f would also weaken the threshold
+                # bound below into a liveness-only check
+                raise ConfigError(
+                    f"FAILURE_SAFETY {f} exceeds the {max_f} byzantine "
+                    f"failures a {n}-member quorum set can tolerate")
+            if thr < n - f:
+                raise ConfigError(
+                    f"quorum threshold {thr} < {n - f} is unsafe for "
+                    f"{n} members tolerating {f} failures; raise the "
+                    "threshold or set UNSAFE_QUORUM = true")
+        for s in inner:
+            self._validate_qset(s, depth + 1)
 
     def network_id(self) -> bytes:
         return sha256(self.NETWORK_PASSPHRASE.encode())
@@ -115,7 +227,10 @@ class Config:
         with open(path, "rb") as f:
             data = tomllib.load(f)
         kw = {}
+        known = set(vars(cls()))
         for k, v in data.items():
+            if k.upper() not in known:
+                raise ConfigError(f"unknown configuration key: {k}")
             kw[k.upper()] = v
         if "NODE_SEED" in kw and isinstance(kw["NODE_SEED"], str):
             from ..crypto.strkey import decode_ed25519_seed
@@ -127,7 +242,9 @@ class Config:
         if "HISTORY_ARCHIVES" in kw:
             kw["HISTORY_ARCHIVES"] = [
                 tuple(a) for a in kw["HISTORY_ARCHIVES"]]
-        return cls(**kw)
+        cfg = cls(**kw)
+        cfg.validate()
+        return cfg
 
     @staticmethod
     def _decode_qset_spec(qs: dict) -> dict:
@@ -157,6 +274,9 @@ def test_config(n: int = 0, **kw) -> Config:
         ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING=True,
         DATABASE=":memory:",
         INVARIANT_CHECKS=[".*"],
+        # test quorums (2-of-3 etc.) are below the byzantine-safety bar
+        # on purpose (ref getTestConfig setting UNSAFE_QUORUM)
+        UNSAFE_QUORUM=True,
     )
     defaults.update(kw)
     return Config(**defaults)

@@ -53,7 +53,11 @@ class CommandHandler:
         return 200, {"info": self.app.get_json_info()}
 
     def metrics(self, params):
-        return 200, {"metrics": self.app.metrics.snapshot()}
+        snap = self.app.metrics.snapshot()
+        root = self.app.ledger_manager.root
+        snap["ledger.prefetch.hit-rate"] = round(
+            root.prefetch_hit_rate(), 4)
+        return 200, {"metrics": snap}
 
     def peers(self, params):
         om = self.app.overlay_manager

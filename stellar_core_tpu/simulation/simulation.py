@@ -42,6 +42,9 @@ class Simulation:
             MANUAL_CLOSE=config_kw.pop("MANUAL_CLOSE", True),
             ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING=True,
             INVARIANT_CHECKS=[".*"],
+            # sim topologies use deliberately small/unsafe quorums
+            # (ref getTestConfig setting UNSAFE_QUORUM)
+            UNSAFE_QUORUM=config_kw.pop("UNSAFE_QUORUM", True),
             **config_kw,
         )
         app = Application(self.clock, cfg)

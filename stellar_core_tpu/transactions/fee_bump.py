@@ -42,6 +42,12 @@ class FeeBumpTransactionFrame:
     def fee_source_id(self) -> bytes:
         return U.muxed_to_account_id(self.fee_bump_tx.feeSource)
 
+    def keys_to_prefetch(self) -> list:
+        from ..ledger.ledger_txn import account_key, key_bytes
+
+        return [key_bytes(account_key(self.fee_source_id()))] + \
+            self.inner_tx.keys_to_prefetch()
+
     # the "source account" for queue/seqnum purposes is the INNER source
     def source_account_id(self) -> bytes:
         return self.inner_tx.source_account_id()

@@ -72,6 +72,56 @@ class TransactionFrame:
     def seq_num(self) -> int:
         return self.tx.seqNum
 
+    def keys_to_prefetch(self) -> list:
+        """Encoded LedgerKeys this tx will likely touch — source accounts
+        plus per-op obvious targets (ref insertKeysForFeeProcessing +
+        insertLedgerKeysToPrefetch; best-effort, misses only cost a later
+        point lookup)."""
+        from ..ledger.ledger_txn import account_key, key_bytes, \
+            trustline_key
+
+        OT = T.OperationType
+        keys = set()
+
+        def acct(aid: bytes):
+            keys.add(key_bytes(account_key(aid)))
+
+        def tl(aid: bytes, asset):
+            if U.is_native(asset):
+                return
+            keys.add(key_bytes(trustline_key(
+                aid, U.to_trustline_asset(asset))))
+
+        acct(self.source_account_id())
+        for opf in self.op_frames:
+            src = opf.source_account_id()
+            acct(src)
+            b = opf.body
+            t = opf.op.body.type
+            if t == OT.CREATE_ACCOUNT:
+                acct(b.destination.value)
+            elif t == OT.PAYMENT:
+                dest = U.muxed_to_account_id(b.destination)
+                acct(dest)
+                tl(src, b.asset)
+                tl(dest, b.asset)
+            elif t in (OT.PATH_PAYMENT_STRICT_RECEIVE,
+                       OT.PATH_PAYMENT_STRICT_SEND):
+                dest = U.muxed_to_account_id(b.destination)
+                acct(dest)
+                tl(src, b.sendAsset)
+                tl(dest, b.destAsset)
+            elif t == OT.ACCOUNT_MERGE:
+                acct(U.muxed_to_account_id(b))
+            elif t == OT.CHANGE_TRUST:
+                if b.line.type != T.AssetType.ASSET_TYPE_POOL_SHARE:
+                    tl(src, T.Asset.make(b.line.type, b.line.value))
+            elif t in (OT.MANAGE_SELL_OFFER, OT.MANAGE_BUY_OFFER,
+                       OT.CREATE_PASSIVE_SELL_OFFER):
+                tl(src, b.selling)
+                tl(src, b.buying)
+        return list(keys)
+
     def full_hash(self) -> bytes:
         """sha256 of the TransactionSignaturePayload — what gets signed AND
         the tx id (ref TransactionFrame::getContentsHash)."""

@@ -198,7 +198,6 @@ def redeem_pool_share_trustlines(ltx, trustor_id: bytes, asset,
     from ..ledger.ledger_txn import entry_to_key
     from . import sponsorship as SP
 
-    header = ltx.header()
     prefix = (T.LedgerEntryType.encode(T.LedgerEntryType.TRUSTLINE)
               + T.AccountID.encode(T.account_id(trustor_id)))
     for entry in list(ltx.entries_by_key_prefix(prefix)):
@@ -254,7 +253,6 @@ def redeem_pool_share_trustlines(ltx, trustor_id: bytes, asset,
             ltx.put(pool_with_cp(pool_entry, cp2))
 
         # 3. park the withdrawn amounts in claimable balances
-        close_time = header.scpValue.closeTime  # noqa: F841 (parity note)
         for amt, a in ((amount_a, cp.params.assetA),
                        (amount_b, cp.params.assetB)):
             if amt <= 0:

@@ -191,9 +191,16 @@ def apply_offer_liabilities(ltx, oe, sign: int) -> bool:
 
     Acquire enforces the balance/limit headroom bounds and returns False
     when the offer does not fit (callers size offers so that cannot
-    happen); release only asserts non-negativity.  Issuer sides carry no
-    liabilities."""
+    happen).  A failing RELEASE means the ledger is already corrupt
+    (liabilities without a holder) and raises at the point of corruption
+    like the reference, rather than desyncing silently.  Issuer sides
+    carry no liabilities."""
     from .operations.base import put_account, put_trustline
+
+    def fail(reason: str) -> bool:
+        if sign < 0:
+            raise ExchangeError(f"liability release failed: {reason}")
+        return False
 
     seller = oe.sellerID.value
     header = ltx.header()
@@ -206,35 +213,35 @@ def apply_offer_liabilities(ltx, oe, sign: int) -> bool:
         if U.is_native(asset):
             entry = ltx.load_account(seller)
             if entry is None:
-                return False
+                return fail("owner account missing")
             acc = entry.data.value
             b, s = U.account_liabilities(acc)
             if is_buy:
                 b += delta
                 if b < 0 or (sign > 0 and b > U.INT64_MAX - acc.balance):
-                    return False
+                    return fail("buying liabilities out of bounds")
             else:
                 s += delta
                 if s < 0 or (sign > 0 and
                              s > acc.balance - U.min_balance(header, acc)):
-                    return False
+                    return fail("selling liabilities out of bounds")
             put_account(ltx, entry, U.set_account_liabilities(acc, b, s))
         elif U.asset_issuer(asset) == seller:
             continue
         else:
             tl_entry = ltx.load_trustline(seller, asset)
             if tl_entry is None:
-                return False
+                return fail("owner trustline missing")
             tl = tl_entry.data.value
             b, s = U.trustline_liabilities(tl)
             if is_buy:
                 b += delta
                 if b < 0 or (sign > 0 and b > tl.limit - tl.balance):
-                    return False
+                    return fail("buying liabilities out of bounds")
             else:
                 s += delta
                 if s < 0 or (sign > 0 and s > tl.balance):
-                    return False
+                    return fail("selling liabilities out of bounds")
             put_trustline(ltx, tl_entry,
                           U.set_trustline_liabilities(tl, b, s))
     return True

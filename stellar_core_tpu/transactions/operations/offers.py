@@ -177,16 +177,15 @@ class ManageOfferOpFrameBase(OperationFrame):
             offer_buying_liabilities, offer_selling_liabilities,
         )
 
-        if can_buy_at_most(header, ltx, src_id, buying) < \
-                offer_buying_liabilities(price, amount):
+        sell_capacity = can_sell_at_most(header, ltx, src_id, selling)
+        buy_capacity = can_buy_at_most(header, ltx, src_id, buying)
+        if buy_capacity < offer_buying_liabilities(price, amount):
             return self._res(C["LINE_FULL"])
-        if can_sell_at_most(header, ltx, src_id, selling) < \
-                offer_selling_liabilities(price, amount):
+        if sell_capacity < offer_selling_liabilities(price, amount):
             return self._res(C["UNDERFUNDED"])
         # crossing limits (ref applyOperationSpecificLimits)
-        max_sheep_send = min(
-            amount, can_sell_at_most(header, ltx, src_id, selling))
-        max_wheat_receive = can_buy_at_most(header, ltx, src_id, buying)
+        max_sheep_send = min(amount, sell_capacity)
+        max_wheat_receive = buy_capacity
         if self.IS_BUY:
             max_wheat_receive = min(max_wheat_receive, self._buy_amount())
 

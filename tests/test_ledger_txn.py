@@ -136,3 +136,39 @@ def test_best_offer_with_uncommitted_overrides(ledger):
         best3 = l1.best_offer(sell_b, buy_b)
         assert best3.data.value.offerID == 3
         l1.rollback()
+
+
+def test_entry_cache_and_prefetch():
+    """Root entry cache: prefetch bulk-loads (incl. negative results),
+    get() hits the cache, and commits write through — deletes included
+    (ref LedgerTxnRoot::prefetch + EntryCache)."""
+    from stellar_core_tpu.ledger.ledger_txn import key_bytes
+
+    ledger = TestLedger()
+    root = ledger.root_txn
+    accounts = [U.make_account_entry(bytes([i]) * 32, 10 ** 9, seq_num=1)
+                for i in range(1, 6)]
+    with LedgerTxn(root) as ltx:
+        for e in accounts:
+            ltx.put(e)
+        ltx.commit()
+    keys = [entry_to_key(e) for e in accounts]
+    kbs = [key_bytes(k) for k in keys]
+    missing_kb = key_bytes(entry_to_key(
+        U.make_account_entry(b"\x77" * 32, 1, seq_num=1)))
+
+    root.clear_entry_cache()
+    root.cache_hits = root.cache_misses = 0
+    assert root.prefetch(kbs + [missing_kb]) == 6
+    for kb in kbs:
+        assert root.get(kb) is not None
+    assert root.get(missing_kb) is None  # cached negative
+    assert root.cache_misses == 0
+    assert root.cache_hits == 6
+    assert root.prefetch_hit_rate() == 1.0
+
+    # write-through: a committed delete must evict the stale positive
+    with LedgerTxn(root) as ltx:
+        ltx.erase(keys[0])
+        ltx.commit()
+    assert root.get(kbs[0]) is None
