@@ -164,13 +164,13 @@ class PendingEnvelopes:
         h = tx_set.contents_hash()
         self.tx_sets[h] = tx_set
         for env in self.pending.pop(h, []):
-            self.herder.scp.receive_envelope(env)
+            self.herder.deliver_ready_envelope(env)
 
     def add_qset(self, qset) -> None:
         h = qset_hash(qset)
         self.qsets[h] = qset
         for env in self.pending.pop(h, []):
-            self.herder.scp.receive_envelope(env)
+            self.herder.deliver_ready_envelope(env)
 
     def get_tx_set(self, h: bytes) -> Optional[TxSetFrame]:
         return self.tx_sets.get(h)
@@ -228,6 +228,10 @@ class Herder:
                        tally_backend=getattr(cfg, "SCP_TALLY_BACKEND",
                                              "host"))
         self.pending_envelopes.add_qset(qset)
+        from .quorum_tracker import QuorumTracker
+
+        self.quorum_tracker = QuorumTracker(cfg.node_id(), qset)
+        self._heard_qsets: Dict[bytes, object] = {}
         self._scp_timers: Dict = {}
         self.trigger_timer = VirtualTimer(app.clock)
         self.on_externalized: List[Callable] = []
@@ -356,7 +360,33 @@ class Herder:
             self.pending_envelopes.record_pending(env, missing)
             self.app.request_scp_items(missing)
             return EnvelopeState.VALID
-        return self.scp.receive_envelope(env)
+        return self.deliver_ready_envelope(env)
+
+    def deliver_ready_envelope(self, env) -> EnvelopeState:
+        """The single seam every ready envelope passes through: SCP
+        processing (which verifies the signature), then quorum tracking
+        only for envelopes that were not rejected — a forged statement
+        must not pollute the tracked topology."""
+        res = self.scp.receive_envelope(env)
+        if res != EnvelopeState.INVALID:
+            self._track_quorum(env)
+        return res
+
+    def _track_quorum(self, env) -> None:
+        """Grow the known transitive quorum from a verified envelope (ref
+        HerderImpl::updateTransitiveQuorum via QuorumTracker)."""
+        from ..scp.statement import companion_qset_hash
+
+        node = env.statement.nodeID.value
+        qset = self.pending_envelopes.get_qset(
+            companion_qset_hash(env.statement))
+        if qset is None:
+            return
+        self._heard_qsets[node] = qset
+        if not self.quorum_tracker.expand(node, qset):
+            # inconsistent announcement: rebuild from everything heard
+            self.quorum_tracker.rebuild(self._heard_qsets.get,
+                                        self.scp.local_node.qset)
 
     def recv_tx_set(self, tx_set: TxSetFrame) -> None:
         self.pending_envelopes.add_tx_set(tx_set)
@@ -457,8 +487,11 @@ class Herder:
         from .quorum_intersection import check_quorum_intersection
 
         if qmap is None:
-            qmap = {self.scp.local_node.node_id:
-                    self.scp.local_node.qset}
+            # the tracked transitive quorum, topped up with the latest
+            # slot's envelopes (covers nodes heard before tracking)
+            qmap = dict(self.quorum_tracker.qset_map())
+            qmap.setdefault(self.scp.local_node.node_id,
+                            self.scp.local_node.qset)
             slot_idx = self.scp.get_high_slot_index()
             slot = self.scp.get_slot(slot_idx, create=False)
             if slot is not None:
@@ -466,7 +499,7 @@ class Herder:
                     node = env.statement.nodeID.value
                     q = slot.qset_from_statement(env.statement)
                     if q is not None:
-                        qmap[node] = q
+                        qmap.setdefault(node, q)
         use_device = self.app.config.CRYPTO_BACKEND == "tpu"
         return check_quorum_intersection(qmap, use_device=use_device)
 

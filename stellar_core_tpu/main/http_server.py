@@ -78,10 +78,15 @@ class CommandHandler:
                                  for side in res.split]
             return 200, body
         qset = self.app.herder.scp.local_node.qset
+        qt = self.app.herder.quorum_tracker
         return 200, {"qset": {
             "threshold": qset.threshold,
             "validators": [v.value.hex() for v in qset.validators],
-            "inner_sets": len(qset.innerSets)}}
+            "inner_sets": len(qset.innerSets)},
+            "transitive": {
+                "node_count": len(qt.quorum),
+                "missing_qsets": [n.hex()[:8]
+                                  for n in qt.nodes_missing_qsets()]}}
 
     def scp(self, params):
         scp = self.app.herder.scp

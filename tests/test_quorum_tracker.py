@@ -1,0 +1,73 @@
+"""QuorumTracker: transitive quorum closure, expand/rebuild semantics
+(ref src/herder/QuorumTracker.{h,cpp})."""
+from stellar_core_tpu.crypto import SecretKey, sha256
+from stellar_core_tpu.herder.quorum_tracker import QuorumTracker
+from stellar_core_tpu.scp.local_node import make_qset
+
+from stellar_core_tpu.simulation.simulation import core
+
+
+def _settle(sim, rounds=200):
+    for _ in range(rounds):
+        if sim.crank() == 0:
+            break
+
+
+def _ids(n):
+    return [SecretKey(sha256(b"qt-%d" % i)).public_key().raw
+            for i in range(n)]
+
+
+def test_local_qset_seeds_the_closure():
+    a, b, c = _ids(3)
+    qt = QuorumTracker(a, make_qset(2, [a, b, c]))
+    assert qt.is_node_definitely_in_quorum(b)
+    assert qt.is_node_definitely_in_quorum(c)
+    assert not qt.is_node_definitely_in_quorum(_ids(4)[3])
+    assert qt.nodes_missing_qsets() == {b, c}
+    # distance-1 nodes name themselves as closest validator
+    assert qt.quorum[b].distance == 1
+    assert qt.quorum[b].closest_validators == {b}
+
+
+def test_expand_extends_two_hops():
+    a, b, c, d = _ids(4)
+    qt = QuorumTracker(a, make_qset(1, [a, b]))
+    # b's qset pulls in c and d transitively
+    assert qt.expand(b, make_qset(2, [c, d]))
+    assert qt.is_node_definitely_in_quorum(c)
+    assert qt.is_node_definitely_in_quorum(d)
+    assert qt.quorum[c].distance == 2
+    assert qt.quorum[c].closest_validators == {b}
+    # re-announcing the identical qset is fine; a different one is not
+    assert qt.expand(b, make_qset(2, [c, d]))
+    assert not qt.expand(b, make_qset(1, [c]))
+    # out-of-closure nodes are a successful no-op (never tracked,
+    # never a rebuild trigger — ref expand returning true there)
+    e = _ids(5)[4]
+    assert qt.expand(e, make_qset(1, [e]))
+    assert not qt.is_node_definitely_in_quorum(e)
+
+
+def test_rebuild_resolves_through_lookup():
+    a, b, c = _ids(3)
+    qsets = {b: make_qset(1, [c])}
+    qt = QuorumTracker(a, make_qset(1, [a, b]))
+    qt.rebuild(qsets.get, make_qset(1, [a, b]))
+    assert qt.is_node_definitely_in_quorum(c)
+    assert qt.qset_map().keys() == {a, b}
+    assert qt.nodes_missing_qsets() == {c}
+
+
+def test_live_sim_tracks_peers():
+    """In a 4-node core sim every node's tracker should learn all four
+    qsets once consensus runs."""
+    sim = core(4, threshold=3)
+    sim.start_all_nodes()
+    _settle(sim)
+    for _ in range(2):
+        assert sim.close_ledger()
+    for app in sim.nodes.values():
+        qt = app.herder.quorum_tracker
+        assert len(qt.qset_map()) == 4
+        assert not qt.nodes_missing_qsets()
