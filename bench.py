@@ -71,8 +71,15 @@ def main() -> None:
         close_txs = min(close_txs, 200)
         kernel_pref = "xla"
 
-    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), test_config())
+    # a close of close_txs transactions needs the ledger's maxTxSetSize
+    # raised (sets above it are invalid) — done through the real upgrade
+    # path on the first close, exactly like the reference's load tests
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), test_config(
+        UPGRADE_DESIRED_MAX_TX_SET_SIZE=max(100, close_txs)))
     app.start()
+    app.herder.manual_close()  # applies the max-tx-set-size upgrade
+    assert app.ledger_manager.last_closed_header().maxTxSetSize >= \
+        close_txs
     lg = LoadGenerator(app)
     lg.create_accounts(min(n_sigs, 2000))
 
@@ -159,6 +166,9 @@ def main() -> None:
         t0 = time.perf_counter()
         app.herder.manual_close()
         close_times.append((time.perf_counter() - t0) * 1000)
+        # the upgraded maxTxSetSize must have let the WHOLE batch close —
+        # a trimmed set would silently measure a smaller close
+        assert app.herder.tx_queue.size() == 0, "close left txs queued"
     close_p50 = statistics.median(close_times) if close_times else None
 
     print(json.dumps({

@@ -39,6 +39,7 @@ class TransactionQueue:
         self.accounts: Dict[bytes, AccountTxs] = {}
         self.banned: List[set] = [set() for _ in range(self.BAN_DEPTH)]
         self.known: Dict[bytes, TransactionFrame] = {}
+        self._ops_count = 0  # running total (capacity checks are O(1))
 
     # -- admission ---------------------------------------------------------
 
@@ -81,12 +82,73 @@ class TransactionQueue:
         if not res.ok:
             return self.ADD_STATUS_ERROR
 
+        # global capacity: evict the cheapest tails, or reject the
+        # newcomer if IT is the cheapest (ref TxQueueLimiter::canAddTx)
+        if not self._make_room_for(frame):
+            return self.ADD_STATUS_TRY_AGAIN_LATER
+
         if acct is None:
             acct = self.accounts[src] = AccountTxs()
         acct.frames.append(frame)
         self.known[h] = frame
+        self._ops_count += frame.num_operations()
         self.app.metrics.counter("herder.pending-txs.count").inc()
         return self.ADD_STATUS_PENDING
+
+    # -- global size limiting (ref src/herder/TxQueueLimiter.h) ------------
+
+    def _capacity_ops(self) -> int:
+        return (self.app.config.TRANSACTION_QUEUE_SIZE_MULTIPLIER
+                * self.app.ledger_manager.last_closed_header()
+                .maxTxSetSize)
+
+    @staticmethod
+    def _fee_rate_lt(a, b) -> bool:
+        """fee-per-op(a) < fee-per-op(b), exact cross-multiply."""
+        return (a.fee_bid() * b.num_operations()
+                < b.fee_bid() * a.num_operations())
+
+    def _make_room_for(self, frame) -> bool:
+        """Evict lowest-fee-rate account tails until the new tx fits;
+        False (reject) when enough room cannot be freed from txs cheaper
+        than the newcomer.  All-or-nothing: victims are only removed
+        once the plan covers the shortfall, so a rejected newcomer never
+        costs the queue anything.  The newcomer's own account chain is
+        never broken.  Evicted txs are banned (BAN_DEPTH ledgers, same
+        as age-outs) so their re-flood doesn't thrash the queue (ref
+        TxQueueLimiter eviction + ban)."""
+        cap = self._capacity_ops()
+        shortfall = self._ops_count + frame.num_operations() - cap
+        if shortfall <= 0:
+            return True
+        src = frame.source_account_id()
+        tails = []  # planned victims, cheapest first, per-account tails
+        depth: Dict[bytes, int] = {}
+        while shortfall > 0:
+            victim_src = None
+            victim = None
+            for vsrc, acct in self.accounts.items():
+                if vsrc == src:
+                    continue  # never break the newcomer's own chain
+                idx = len(acct.frames) - 1 - depth.get(vsrc, 0)
+                if idx < 0:
+                    continue
+                tail = acct.frames[idx]
+                if victim is None or self._fee_rate_lt(tail, victim):
+                    victim_src = vsrc
+                    victim = tail
+            if victim is None or not self._fee_rate_lt(victim, frame):
+                return False  # can't free enough from cheaper txs
+            tails.append((victim_src, victim))
+            depth[victim_src] = depth.get(victim_src, 0) + 1
+            shortfall -= victim.num_operations()
+        for victim_src, victim in tails:
+            self.accounts[victim_src].frames.pop()
+            self.known.pop(victim.full_hash(), None)
+            self.banned[0].add(victim.full_hash())
+            self._ops_count -= victim.num_operations()
+            self.app.metrics.counter("herder.pending-txs.count").dec()
+        return True
 
     # -- harvesting --------------------------------------------------------
 
@@ -126,6 +188,9 @@ class TransactionQueue:
                     if acct.age >= self.PENDING_DEPTH or not kept:
                         self.accounts.pop(src, None)
             ltx.rollback()
+        self._ops_count = sum(f.num_operations()
+                              for acct in self.accounts.values()
+                              for f in acct.frames)
         self.app.metrics.counter("herder.pending-txs.count").set_count(
             len(self.known))
 

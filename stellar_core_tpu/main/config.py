@@ -94,6 +94,10 @@ class Config:
         # closed-slot retention for SCP state (ref MAX_SLOTS_TO_REMEMBER)
         self.MAX_SLOTS_TO_REMEMBER: int = kw.get(
             "MAX_SLOTS_TO_REMEMBER", 12)
+        # mempool capacity = multiplier x ledger maxTxSetSize ops (ref
+        # TRANSACTION_QUEUE_SIZE_MULTIPLIER feeding TxQueueLimiter)
+        self.TRANSACTION_QUEUE_SIZE_MULTIPLIER: int = kw.get(
+            "TRANSACTION_QUEUE_SIZE_MULTIPLIER", 4)
 
         # catchup (ref CATCHUP_COMPLETE: replay every ledger instead of
         # assuming bucket state at the anchor checkpoint)

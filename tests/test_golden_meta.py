@@ -178,10 +178,63 @@ def scenario_sponsorship_cb_pool(app):
     app.herder.manual_close()
 
 
+def scenario_revocation(app):
+    """Offer liabilities + full auth revocation: pulled offers and
+    CAP-38 pool-share redemption into claimable balances."""
+    root = NodeAccount(app, SecretKey(app.config.network_id()))
+    issuer = NodeAccount(app, SecretKey(sha256(b"g-rv-issuer")))
+    trader = NodeAccount(app, SecretKey(sha256(b"g-rv-trader")))
+    seq = root.next_seq()
+    for i, acc in enumerate((issuer, trader)):
+        app.herder.recv_transaction(root.tx(
+            [root.op_create_account(acc.account_id, 10**10)],
+            seq=seq + i))
+    app.herder.manual_close()
+    app.herder.recv_transaction(issuer.tx([issuer.op_set_options(
+        set_flags=T.AUTH_REQUIRED_FLAG | T.AUTH_REVOCABLE_FLAG)]))
+    app.herder.manual_close()
+    usd = U.make_asset(b"RUSD", issuer.account_id)
+    app.herder.recv_transaction(trader.tx([trader.op_change_trust(usd)]))
+    app.herder.manual_close()
+    app.herder.recv_transaction(issuer.tx([
+        issuer.op(T.OperationType.SET_TRUST_LINE_FLAGS,
+                  T.SetTrustLineFlagsOp.make(
+                      trustor=T.account_id(trader.account_id), asset=usd,
+                      clearFlags=0, setFlags=T.AUTHORIZED_FLAG)),
+        issuer.op_payment(trader.account_id, 10**6, usd)]))
+    app.herder.manual_close()
+    # a resting offer (liabilities acquired) + a pool-share deposit
+    app.herder.recv_transaction(trader.tx([trader.op(
+        T.OperationType.MANAGE_SELL_OFFER,
+        T.ManageSellOfferOp.make(
+            selling=usd, buying=U.asset_native(), amount=1000,
+            price=T.Price.make(n=3, d=2), offerID=0))]))
+    app.herder.manual_close()
+    app.herder.recv_transaction(trader.tx(
+        [trader.op_change_trust_pool(U.asset_native(), usd)]))
+    app.herder.manual_close()
+    params = T.LiquidityPoolParameters.make(
+        T.LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT,
+        T.LiquidityPoolConstantProductParameters.make(
+            assetA=U.asset_native(), assetB=usd,
+            fee=T.LIQUIDITY_POOL_FEE_V18))
+    app.herder.recv_transaction(trader.tx([trader.op_pool_deposit(
+        LP.pool_id_from_params(params), 3 * 10**5, 10**5)]))
+    app.herder.manual_close()
+    # full revocation: offer pulled, pool shares parked in CBs
+    app.herder.recv_transaction(issuer.tx([issuer.op(
+        T.OperationType.SET_TRUST_LINE_FLAGS,
+        T.SetTrustLineFlagsOp.make(
+            trustor=T.account_id(trader.account_id), asset=usd,
+            clearFlags=T.AUTHORIZED_FLAG, setFlags=0))]))
+    app.herder.manual_close()
+
+
 SCENARIOS = {
     "payments": scenario_payments,
     "trust_and_dex": scenario_trust_and_dex,
     "sponsorship_cb_pool_feebump": scenario_sponsorship_cb_pool,
+    "revocation": scenario_revocation,
 }
 
 

@@ -171,3 +171,83 @@ def test_invariants_run_during_close(app):
     app.herder.recv_transaction(env)
     app.herder.manual_close()  # would raise InvariantDoesNotHold on breach
     assert app.invariants.invariants  # non-empty set actually ran
+
+
+def test_queue_limiter_evicts_cheapest(app):
+    """Global mempool cap: when full, a higher-fee tx evicts the
+    cheapest tail; a lower-or-equal-fee tx is refused
+    (ref src/herder/TxQueueLimiter.h)."""
+    root = root_account(app)
+    q = app.herder.tx_queue
+
+    # zero capacity: nothing fits and nothing can be evicted
+    app.config.TRANSACTION_QUEUE_SIZE_MULTIPLIER = 0
+    a = SecretKey(sha256(b"lim-a"))
+    env = root.tx([root.op_create_account(a.public_key().raw, 10 ** 10)])
+    assert app.herder.recv_transaction(env) == \
+        TransactionQueue.ADD_STATUS_TRY_AGAIN_LATER
+
+    # restore capacity, set up three funded accounts
+    app.config.TRANSACTION_QUEUE_SIZE_MULTIPLIER = 4
+    accs = []
+    for i in range(3):
+        acct = NodeAccount(app, SecretKey(sha256(b"lim-%d" % i)))
+        env = root.tx([root.op_create_account(acct.account_id, 10 ** 10)])
+        assert app.herder.recv_transaction(env) == 0
+        app.herder.manual_close()
+        accs.append(acct)
+
+    # narrow the global cap to 2 ops and fill it
+    q._capacity_ops = lambda: 2
+    dest = root.account_id
+    cheap = accs[0].tx([accs[0].op_payment(dest, 1)], fee=100)
+    mid = accs[1].tx([accs[1].op_payment(dest, 1)], fee=150)
+    assert app.herder.recv_transaction(cheap) == 0
+    assert app.herder.recv_transaction(mid) == 0
+    assert q.size() == 2
+
+    # not pricier than the cheapest queued: refused
+    low = accs[2].tx([accs[2].op_payment(dest, 1)], fee=100)
+    assert app.herder.recv_transaction(low) == \
+        TransactionQueue.ADD_STATUS_TRY_AGAIN_LATER
+    # pricier: evicts the cheapest, which gets banned
+    rich = accs[2].tx([accs[2].op_payment(dest, 1)], fee=500)
+    assert app.herder.recv_transaction(rich) == 0
+    assert q.size() == 2
+    from stellar_core_tpu.transactions.frame import tx_frame_from_envelope
+    evicted = tx_frame_from_envelope(app.config.network_id(), cheap)
+    assert q.is_banned(evicted.full_hash())
+
+    # queue now holds mid(150) + rich(500); accs[2]'s next tx must evict
+    # the OTHER account's tail, never break its own chain
+    tail_seq = q.accounts[accs[2].account_id].frames[-1].seq_num()
+    rich2 = accs[2].tx([accs[2].op_payment(dest, 1)], fee=9999,
+                       seq=tail_seq + 1)
+    assert app.herder.recv_transaction(rich2) == 0
+    assert q.size() == 2
+    assert len(q.accounts[accs[2].account_id].frames) == 2
+    evicted_mid = tx_frame_from_envelope(app.config.network_id(), mid)
+    assert q.is_banned(evicted_mid.full_hash())
+
+    # all-or-nothing: a 2-op newcomer that cannot fully fit must leave
+    # the queue untouched (nothing evicted, nothing banned)
+    before = q.size()
+    acct4 = NodeAccount(app, SecretKey(sha256(b"lim-4")))
+    env = root.tx([root.op_create_account(acct4.account_id, 10 ** 10)])
+    del q._capacity_ops
+    assert app.herder.recv_transaction(env) == 0
+    app.herder.manual_close()
+    q._capacity_ops = lambda: 2
+    # queue drained by the close; refill with one cheap + try a 2-op tx
+    # worth less per-op than what must be displaced
+    c1 = accs[0].tx([accs[0].op_payment(dest, 1)], fee=400)
+    c2 = accs[1].tx([accs[1].op_payment(dest, 1)], fee=100)
+    assert app.herder.recv_transaction(c1) == 0
+    assert app.herder.recv_transaction(c2) == 0
+    big = acct4.tx([acct4.op_payment(dest, 1),
+                    acct4.op_payment(dest, 2)], fee=400)  # 200/op
+    assert app.herder.recv_transaction(big) == \
+        TransactionQueue.ADD_STATUS_TRY_AGAIN_LATER
+    assert q.size() == 2  # c1 + c2 both intact
+    c2f = tx_frame_from_envelope(app.config.network_id(), c2)
+    assert not q.is_banned(c2f.full_hash())
