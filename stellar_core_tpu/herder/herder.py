@@ -501,7 +501,11 @@ class Herder:
                     if q is not None:
                         qmap.setdefault(node, q)
         use_device = self.app.config.CRYPTO_BACKEND == "tpu"
-        return check_quorum_intersection(qmap, use_device=use_device)
+        return check_quorum_intersection(
+            qmap, use_device=use_device,
+            max_calls=self.app.config.QUORUM_INTERSECTION_MAX_CALLS,
+            max_seconds=self.app.config
+            .QUORUM_INTERSECTION_TIMEOUT_SECONDS)
 
     def _persist_scp_history(self, slot_index: int) -> None:
         """Persist the slot's SCP envelopes for audit + history publish

@@ -127,8 +127,8 @@ def cmd_self_check(cfg: Config) -> int:
                     break
         checks["bucketlist_consistent_with_database"] = consistent
     qic = app.herder.check_quorum_intersection()
-    checks["quorum_intersection"] = qic.ok
-    ok = all(checks.values())
+    checks["quorum_intersection"] = qic.ok  # None = budget hit: unknown
+    ok = all(v is not False for v in checks.values())
     print(json.dumps({"ok": ok, "checks": checks}))
     return 0 if ok else 1
 

@@ -70,9 +70,11 @@ class CommandHandler:
     def quorum(self, params):
         if params.get("intersection") == "true":
             res = self.app.herder.check_quorum_intersection()
-            body = {"intersection": res.ok,
+            body = {"intersection": res.ok,  # null = scan budget hit
                     "scanned_subsets": res.scanned,
                     "scc_size": res.scc_size}
+            if res.aborted:
+                body["aborted"] = True
             if res.split:
                 body["split"] = [[n.hex() for n in side]
                                  for side in res.split]

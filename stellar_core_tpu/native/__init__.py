@@ -17,7 +17,8 @@ from typing import Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "_native.so")
-_SRC = os.path.join(_DIR, "bucket_merge.cpp")
+_SRCS = [os.path.join(_DIR, f) for f in ("bucket_merge.cpp",
+                                         "quorum_enum.cpp")]
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -27,7 +28,7 @@ _tried = False
 def _build() -> bool:
     try:
         r = subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", _SO + ".tmp", _SRC],
+            ["g++", "-O2", "-shared", "-fPIC", "-o", _SO + ".tmp"] + _SRCS,
             capture_output=True, timeout=120)
         if r.returncode != 0:
             return False
@@ -45,8 +46,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO) or \
-                os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < max(
+                os.path.getmtime(s) for s in _SRCS):
             if not _build():
                 return None
         try:
@@ -64,6 +65,26 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int32),
+        ]
+        if not hasattr(lib, "quorum_enum_check"):
+            # stale prebuilt .so (mtime newer than sources but missing
+            # newer symbols): degrade to the Python tiers rather than
+            # crash callers that only need the older entry points
+            _lib = lib
+            return _lib
+        lib.quorum_enum_check.restype = ctypes.c_int64
+        lib.quorum_enum_check.argtypes = [
+            ctypes.c_int32,                      # n_nodes
+            ctypes.POINTER(ctypes.c_int32),      # top_thr [n]
+            ctypes.POINTER(ctypes.c_uint64),     # top_mem [n*W]
+            ctypes.POINTER(ctypes.c_int32),      # inner_off [n+1]
+            ctypes.POINTER(ctypes.c_int32),      # inner_thr [total]
+            ctypes.POINTER(ctypes.c_uint64),     # inner_mem [total*W]
+            ctypes.POINTER(ctypes.c_int32),      # interrupt flag (polled)
+            ctypes.c_int64,                      # max_calls (0 = unlimited)
+            ctypes.POINTER(ctypes.c_uint64),     # out_q1 [W]
+            ctypes.POINTER(ctypes.c_uint64),     # out_q2 [W]
+            ctypes.POINTER(ctypes.c_int64),      # out_calls
         ]
         lib.bucket_lower_bound.restype = None
         lib.bucket_lower_bound.argtypes = [

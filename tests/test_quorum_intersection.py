@@ -126,6 +126,124 @@ class TestChecker:
         assert _contract_host(set(n), qmap) == set(n)
         assert _contract_host(set(n[:2]), qmap) == set()
 
+    def test_org_topology_36_nodes_scales(self):
+        """12 orgs x 3 validators (the shape of the real network): the
+        pruned enumeration must finish fast where the old exhaustive scan
+        capped out at 20 nodes (ref MinQuorumEnumerator early exits)."""
+        import time
+
+        n = ids(36)
+        orgs = [(2, n[3 * i:3 * i + 3]) for i in range(12)]
+        qmap = {x: qset(9, [], orgs) for x in n}
+        t0 = time.monotonic()
+        res = check_quorum_intersection(qmap, use_device=False)
+        assert res.ok and res.scc_size == 36
+        assert time.monotonic() - t0 < 30
+
+    def test_org_topology_split_detected_at_scale(self):
+        """Two halves of a 24-node network each trusting only their own
+        orgs: a disjoint quorum pair must be found, not just timeout."""
+        n = ids(24)
+        left_orgs = [(2, n[3 * i:3 * i + 3]) for i in range(4)]
+        right_orgs = [(2, n[3 * i:3 * i + 3]) for i in range(4, 8)]
+        qmap = {x: qset(3, [], left_orgs) for x in n[:12]}
+        qmap.update({x: qset(3, [], right_orgs) for x in n[12:]})
+        res = check_quorum_intersection(qmap, use_device=False)
+        assert not res.ok
+        q1, q2 = res.split
+        assert q1 and q2 and not (q1 & q2)
+        assert all(LN.is_quorum_slice(qmap[x], q1) for x in q1)
+        assert all(LN.is_quorum_slice(qmap[x], q2) for x in q2)
+
+    @pytest.mark.parametrize("seed", range(12))
+    def test_org_reduction_vs_brute_force(self, seed):
+        """Randomized pure-org topologies (incl. weak orgs where
+        2*t <= |org|, which two disjoint quorums may share) must agree
+        with brute force — exercises the symmetric-org reduction."""
+        rng = random.Random(1000 + seed)
+        n_orgs = rng.randint(2, 3)
+        sizes = [rng.randint(2, 3) for _ in range(n_orgs)]
+        nodes = ids(sum(sizes))
+        orgs, i = [], 0
+        for s in sizes:
+            orgs.append((rng.randint(1, s), nodes[i:i + s]))
+            i += s
+        thr = rng.randint(1, n_orgs)
+        qmap = {x: qset(thr, [], orgs) for x in nodes}
+        res = check_quorum_intersection(qmap, use_device=False)
+        assert res.ok == brute_force_disjoint(qmap), f"seed {1000 + seed}"
+        if not res.ok:
+            q1, q2 = res.split
+            assert q1 and q2 and not (q1 & q2)
+            assert all(LN.is_quorum_slice(qmap[x], q1) for x in q1)
+            assert all(LN.is_quorum_slice(qmap[x], q2) for x in q2)
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_native_vs_python_enumerator(self, seed):
+        """The native branch-and-bound and the Python/device frontier
+        enumerator walk the same pruned tree and must agree (asymmetric
+        qsets so the org reduction does not short-circuit)."""
+        rng = random.Random(2000 + seed)
+        n_nodes = rng.randint(3, 7)
+        nodes = ids(n_nodes)
+        qmap = {}
+        for x in nodes:
+            k = rng.randint(1, n_nodes)
+            members = rng.sample(nodes, k)
+            qmap[x] = qset(rng.randint(1, k), members)
+        nat = check_quorum_intersection(qmap, use_device=False,
+                                        use_native=True)
+        py = check_quorum_intersection(qmap, use_device=False,
+                                       use_native=False)
+        assert nat.ok == py.ok == brute_force_disjoint(qmap), \
+            f"seed {2000 + seed}"
+
+    def test_interrupt_flag_aborts(self):
+        """An already-set interrupt aborts the enumerator up front
+        (ref QuorumIntersectionChecker::InterruptedException)."""
+        import threading
+
+        from stellar_core_tpu.herder.quorum_intersection import (
+            InterruptedError_,
+        )
+
+        n = ids(8)
+        qmap = {x: qset(5, n) for x in n}
+        flag = threading.Event()
+        flag.set()
+        with pytest.raises(InterruptedError_):
+            check_quorum_intersection(qmap, use_device=False,
+                                      interrupt=flag)
+
+    def test_call_budget_reports_unknown(self):
+        """An exhausted max_calls budget yields ok=None/aborted=True —
+        never a false verdict (asymmetric qset defeats the org
+        reduction; budget of 1 call can't complete any scan)."""
+        rng = random.Random(7)
+        n = ids(8)
+        qmap = {}
+        for i, x in enumerate(n):
+            members = rng.sample(n, 5 + (i % 3))
+            qmap[x] = qset(3 + (i % 2), members)
+        res = check_quorum_intersection(qmap, use_device=False,
+                                        max_calls=1)
+        assert res.ok is None and res.aborted
+        res_py = check_quorum_intersection(qmap, use_device=False,
+                                           use_native=False, max_calls=1)
+        assert res_py.ok is None and res_py.aborted
+
+    def test_deep_nested_qsets_use_host_walk(self):
+        """>2-level quorum sets fall back to the exact recursive host
+        contraction and still get the pruned enumeration."""
+        n = ids(6)
+        # depth-3: inner set containing an inner set
+        deep_inner = LN.make_qset(1, n[4:6],
+                                  [LN.make_qset(2, n[2:4])])
+        q = LN.make_qset(2, n[0:2], [deep_inner])
+        qmap = {x: q for x in n}
+        res = check_quorum_intersection(qmap, use_device=False)
+        assert res.ok == brute_force_disjoint(qmap)
+
     def test_herder_endpoint(self):
         from stellar_core_tpu.main import Application, test_config
         from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
