@@ -31,6 +31,7 @@ class TxSetFrame:
         # canonical order: sorted by full hash (ref sortTxsInHashOrder)
         self.frames = sorted(frames, key=lambda f: f.full_hash())
         self._hash: Optional[bytes] = None
+        self._valid_cache: Dict[bytes, bool] = {}
 
     # -- construction ------------------------------------------------------
 
@@ -94,9 +95,29 @@ class TxSetFrame:
     def check_valid(self, ltx_root, lcl_hash: bytes,
                     verify=None) -> bool:
         """ref TxSetFrame::checkValid :562 — prev-hash linkage, size cap,
-        hash order, per-source seq continuity, per-tx checkValid."""
+        hash order, per-source seq continuity, per-tx checkValid.
+
+        The result is cached per LCL hash: SCP re-validates the same value
+        once per envelope (every nomination/ballot message carrying it),
+        and ledger state — the only input besides the set itself — cannot
+        change without the LCL hash changing.  Without this a 1000-tx
+        close re-runs the full per-tx chain ~8x (measured r4 profile)."""
         if self.previous_ledger_hash != lcl_hash:
             return False
+        if verify is not None:
+            # a custom verifier must actually run: bypass the cache both
+            # ways (don't read a verdict it didn't produce, don't publish
+            # one keyed only by lcl_hash)
+            return self._check_valid_uncached(ltx_root, lcl_hash, verify)
+        cached = self._valid_cache.get(lcl_hash)
+        if cached is not None:
+            return cached
+        ok = self._check_valid_uncached(ltx_root, lcl_hash, verify)
+        self._valid_cache = {lcl_hash: ok}
+        return ok
+
+    def _check_valid_uncached(self, ltx_root, lcl_hash: bytes,
+                              verify=None) -> bool:
         with LedgerTxn(ltx_root) as _hltx:
             max_ops = _hltx.header().maxTxSetSize
             _hltx.rollback()

@@ -91,6 +91,21 @@ def key_bytes(key) -> bytes:
     return T.LedgerKey.encode(key)
 
 
+# account LedgerKey encodings are the hottest key path (every fee / seqnum /
+# signature check loads the source account); cache them by raw account id
+_ACCOUNT_KB: Dict[bytes, bytes] = {}
+
+
+def account_key_bytes(account_id: bytes) -> bytes:
+    kb = _ACCOUNT_KB.get(account_id)
+    if kb is None:
+        if len(_ACCOUNT_KB) >= 1 << 16:
+            _ACCOUNT_KB.clear()
+        kb = key_bytes(account_key(account_id))
+        _ACCOUNT_KB[account_id] = kb
+    return kb
+
+
 class AbstractLedgerTxn:
     """Shared read/write surface of LedgerTxn and LedgerTxnRoot."""
 
@@ -106,7 +121,7 @@ class AbstractLedgerTxn:
         return self.get(key_bytes(key))
 
     def load_account(self, account_id: bytes):
-        return self.load(account_key(account_id))
+        return self.get(account_key_bytes(account_id))
 
     def load_trustline(self, account_id: bytes, asset):
         return self.load(trustline_key(account_id, asset))
@@ -134,6 +149,7 @@ class LedgerTxn(AbstractLedgerTxn):
                 raise LedgerTxnError("parent already has an open child")
             parent._child = self
         self._delta: Dict[bytes, Optional[object]] = {}
+        self._vkeys: set = set()  # virtual (\xff) keys present in _delta
         self._header = None  # modified header, if any
         self._child: Optional["LedgerTxn"] = None
         self._open = True
@@ -190,22 +206,27 @@ class LedgerTxn(AbstractLedgerTxn):
         self._check_open()
         assert kb.startswith(VIRTUAL_PREFIX)
         self._delta[kb] = value
+        self._vkeys.add(kb)
 
     def erase_virtual(self, kb: bytes) -> None:
         self._check_open()
         assert kb.startswith(VIRTUAL_PREFIX)
         self._delta[kb] = None
+        self._vkeys.add(kb)
 
     def live_virtual_keys(self, prefix: bytes) -> List[bytes]:
         """Virtual keys with a live (non-erased) value visible from this
-        layer, walking the parent chain (root never has any)."""
+        layer, walking the parent chain (root never has any).  Each layer
+        indexes its virtual keys (``_vkeys``) so this never scans the
+        ordinary entry delta — unindexed it was O(total delta) per call,
+        quadratic over a big close."""
         self._check_open()
         seen: Dict[bytes, Optional[object]] = {}
         layer = self
         while isinstance(layer, LedgerTxn):
-            for kb, v in layer._delta.items():
+            for kb in layer._vkeys:
                 if kb.startswith(prefix) and kb not in seen:
-                    seen[kb] = v
+                    seen[kb] = layer._delta[kb]
             layer = layer.parent
         return [kb for kb, v in seen.items() if v is not None]
 
@@ -217,6 +238,7 @@ class LedgerTxn(AbstractLedgerTxn):
             self.parent._commit_from_child(self._delta, self._header)
         else:
             self.parent._delta.update(self._delta)
+            self.parent._vkeys |= self._vkeys
             if self._header is not None:
                 self.parent._header = self._header
         self._close()

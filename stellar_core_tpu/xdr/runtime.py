@@ -139,12 +139,14 @@ class Opaque(XdrType):
 
     def __init__(self, n: int):
         self.n = n
+        self._padding = _pad(n)  # precomputed; b"" when n % 4 == 0
 
     def pack(self, v, out):
         if len(v) != self.n:
             raise XdrError(f"opaque[{self.n}] got {len(v)} bytes")
-        out.append(bytes(v))
-        out.append(_pad(self.n))
+        out.append(v if type(v) is bytes else bytes(v))
+        if self._padding:
+            out.append(self._padding)
 
     def unpack(self, r):
         v = r.take(self.n)
@@ -241,13 +243,16 @@ class Enum(XdrType):
         self.name = name
         self.by_name = dict(values)
         self.by_value = {v: k for k, v in values.items()}
+        # enum wire bytes precomputed per value (hot: every union disc)
+        self._enc = {v: struct.pack(">i", v) for v in self.by_value}
         for k, v in values.items():
             setattr(self, k, v)
 
     def pack(self, v, out):
-        if v not in self.by_value:
+        b = self._enc.get(v)
+        if b is None:
             raise XdrError(f"bad {self.name} value {v}")
-        out.append(struct.pack(">i", v))
+        out.append(b)
 
     def unpack(self, r):
         v = struct.unpack(">i", r.take(4))[0]
@@ -265,9 +270,12 @@ class _StructValue:
     __slots__ = ("_fields", "__dict__")
 
     def __init__(self, _fields: Sequence[str], **kw):
-        self._fields = tuple(_fields)
+        self._fields = _fields if type(_fields) is tuple else tuple(_fields)
+        d = self.__dict__
+        d.update(kw)
         for f in self._fields:
-            setattr(self, f, kw.get(f))
+            if f not in d:
+                d[f] = None
 
     def __eq__(self, other):
         return (
@@ -283,16 +291,29 @@ class _StructValue:
         return f"({body})"
 
     def _replace(self, **kw):
-        vals = {f: getattr(self, f) for f in self._fields}
-        vals.update(kw)
-        return _StructValue(self._fields, **vals)
+        new = _StructValue.__new__(_StructValue)
+        new._fields = self._fields
+        new.__dict__.update(self.__dict__)
+        new.__dict__.pop("_xdr_enc", None)  # drop any memoized encoding
+        new.__dict__.update(kw)
+        return new
 
 
 class Struct(XdrType):
+    # memoize=True caches the encoding on the value object itself (under
+    # "_xdr_enc" in its __dict__; _replace drops it).  Only safe for types
+    # whose values are immutable-by-convention AND reused across encodes —
+    # a LedgerEntry flows through tx meta, the bucket list, and the SQL
+    # commit in one close, which otherwise encodes it three times.
+    memoize = False
+
     def __init__(self, name: str, fields: Sequence[Tuple[str, XdrType]]):
         self.name = name
         self.fields = list(fields)
-        self.field_names = [f for f, _ in fields]
+        # a shared tuple: _StructValue keeps a reference instead of copying
+        self.field_names = tuple(f for f, _ in fields)
+        # bound pack methods: the encode hot loop skips attribute dispatch
+        self._packers = [(f, t.pack) for f, t in fields]
 
     def make(self, **kw):
         unknown = set(kw) - set(self.field_names)
@@ -301,10 +322,34 @@ class Struct(XdrType):
         return _StructValue(self.field_names, **kw)
 
     def pack(self, v, out):
-        for fname, ftype in self.fields:
+        d = getattr(v, "__dict__", None)
+        if d is None:  # e.g. a namedtuple-like stand-in
+            for fname, fpack in self._packers:
+                try:
+                    fpack(getattr(v, fname), out)
+                except (AttributeError, TypeError, XdrError) as e:
+                    raise XdrError(f"{self.name}.{fname}: {e}") from e
+            return
+        if self.memoize:
+            hit = d.get("_xdr_enc")
+            if hit is not None and hit[0] is self:
+                out.append(hit[1])
+                return
+            sub: List[bytes] = []
+            for fname, fpack in self._packers:
+                try:
+                    fpack(d[fname], sub)
+                except (KeyError, AttributeError, TypeError,
+                        XdrError) as e:
+                    raise XdrError(f"{self.name}.{fname}: {e}") from e
+            enc = b"".join(sub)
+            d["_xdr_enc"] = (self, enc)
+            out.append(enc)
+            return
+        for fname, fpack in self._packers:
             try:
-                ftype.pack(getattr(v, fname), out)
-            except (AttributeError, TypeError, XdrError) as e:
+                fpack(d[fname], out)
+            except (KeyError, AttributeError, TypeError, XdrError) as e:
                 raise XdrError(f"{self.name}.{fname}: {e}") from e
 
     def unpack(self, r):
@@ -313,12 +358,13 @@ class Struct(XdrType):
 
 
 class _UnionValue:
-    __slots__ = ("type", "value", "arm")
+    __slots__ = ("type", "value", "arm", "_enc")
 
     def __init__(self, type_, value=None, arm: str = ""):
         self.type = type_
         self.value = value
         self.arm = arm
+        self._enc = None  # (union_type, bytes) memo for memoize unions
 
     def __eq__(self, other):
         return (
@@ -360,7 +406,23 @@ class Union(XdrType):
         arm_name, _ = self._arm(d)
         return _UnionValue(d, value, arm_name)
 
+    memoize = False  # see Struct.memoize
+
     def pack(self, v, out):
+        if self.memoize:
+            hit = v._enc
+            if hit is not None and hit[0] is self:
+                out.append(hit[1])
+                return
+            sub: List[bytes] = []
+            self._pack_inner(v, sub)
+            enc = b"".join(sub)
+            v._enc = (self, enc)
+            out.append(enc)
+            return
+        self._pack_inner(v, out)
+
+    def _pack_inner(self, v, out):
         self.disc.pack(v.type, out)
         arm_name, arm_type = self._arm(v.type)
         if arm_type is not None:

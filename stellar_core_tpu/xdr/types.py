@@ -358,6 +358,10 @@ LedgerEntry = Struct("LedgerEntry", [
         1: ("v1", LedgerEntryExtensionV1),
     })),
 ])
+# one LedgerEntry flows through tx meta + bucket list + SQL commit per
+# close; memoized encoding collapses those to a single pack (values are
+# immutable-by-convention: all mutation goes through _replace)
+LedgerEntry.memoize = True
 
 _LKAccount = Struct("LedgerKeyAccount", [("accountID", AccountID)])
 _LKTrustLine = Struct("LedgerKeyTrustLine", [
@@ -772,6 +776,9 @@ TransactionEnvelope = Union("TransactionEnvelope", EnvelopeType, {
     EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP:
         ("feeBump", FeeBumpTransactionEnvelope),
 })
+# an envelope is encoded at admission (hash), flood, tx-set hashing, and
+# tx-history persistence — memoize like LedgerEntry
+TransactionEnvelope.memoize = True
 
 TransactionSignaturePayload = Struct("TransactionSignaturePayload", [
     ("networkId", Hash),
