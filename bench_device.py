@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Device stage of the headline bench, run as a SUBPROCESS of bench.py.
+
+Separated so the parent can pin itself to JAX_PLATFORMS=cpu (all workload
+construction is host work) while this process owns the TPU: the relay is
+exclusive and a wedged tunnel must never take the whole bench down.
+
+Usage: bench_device.py <workload.npz>; prints ONE JSON line
+{"kernel": "pallas"|"xla", "rate": verifies_per_sec, "n": N,
+ "compile_s": S, "device": jax device kind}.
+"""
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    npz = sys.argv[1]
+    os.environ.pop("JAX_PLATFORMS", None)
+
+    import jax
+    import numpy as np
+
+    dev = jax.devices()[0]
+    data = np.load(npz)
+    pk, sg, mg = data["pk"], data["sg"], data["mg"]
+    n = pk.shape[0]
+
+    kernel_pref = os.environ.get("BENCH_KERNEL", "pallas")
+    verify_batch = None
+    kernel_used = None
+    if kernel_pref == "pallas":
+        try:
+            from stellar_core_tpu.ops.ed25519_pallas import verify_batch as vb
+
+            ok = np.asarray(vb(pk[:512], sg[:512], mg[:512]))
+            assert ok.all(), "pallas kernel rejected valid signatures"
+            verify_batch = vb
+            kernel_used = "pallas"
+        except Exception as e:
+            print(f"[bench-device] pallas unavailable: {e!r}",
+                  file=sys.stderr, flush=True)
+    if verify_batch is None:
+        from stellar_core_tpu.ops.ed25519_kernel import verify_batch as vb
+
+        verify_batch = vb
+        kernel_used = "xla"
+
+    t0 = time.perf_counter()
+    ok = np.asarray(verify_batch(pk, sg, mg))  # compile + warm
+    compile_s = time.perf_counter() - t0
+    assert ok.all(), f"kernel rejected {int((~ok).sum())} valid signatures"
+
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ok = np.asarray(verify_batch(pk, sg, mg))
+    dt = (time.perf_counter() - t0) / reps
+    print(json.dumps({
+        "kernel": kernel_used,
+        "rate": round(n / dt, 1),
+        "n": n,
+        "compile_s": round(compile_s, 1),
+        "device": getattr(dev, "platform", str(dev)),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -22,8 +22,18 @@ class HistoryManager:
     def __init__(self, app):
         self.app = app
         self.archives: List[HistoryArchive] = []
-        for name, path in getattr(app.config, "HISTORY_ARCHIVES", []):
-            self.archives.append(HistoryArchive(name, path))
+        for spec in getattr(app.config, "HISTORY_ARCHIVES", []):
+            if isinstance(spec, dict):
+                from .archive import CommandArchive
+
+                self.archives.append(CommandArchive(
+                    spec["name"], get_cmd=spec.get("get"),
+                    put_cmd=spec.get("put"),
+                    mkdir_cmd=spec.get("mkdir"),
+                    process_manager=app.process_manager))
+            else:
+                name, path = spec
+                self.archives.append(HistoryArchive(name, path))
         self.published_checkpoints = 0
         # replay (catchup) closes must not re-publish into the archive
         # being read — see ApplyCheckpointsWork

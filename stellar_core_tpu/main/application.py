@@ -26,6 +26,17 @@ class Application:
     def __init__(self, clock: VirtualClock, config: Config):
         self.clock = clock
         self.config = config
+        # resolve "auto" device backends once, before any subsystem reads
+        # them: default-on TPU when a device answers the (never-killed,
+        # bounded-wait) subprocess probe, CPU tiers otherwise
+        if "auto" in (config.CRYPTO_BACKEND, config.SCP_TALLY_BACKEND):
+            from ..utils.device import device_available
+
+            alive = device_available()
+            if config.CRYPTO_BACKEND == "auto":
+                config.CRYPTO_BACKEND = "tpu" if alive else "cpu"
+            if config.SCP_TALLY_BACKEND == "auto":
+                config.SCP_TALLY_BACKEND = "tensor" if alive else "host"
         self.metrics = MetricsRegistry(clock)
         self.scheduler = Scheduler(clock)
         from ..database import Database
@@ -38,16 +49,18 @@ class Application:
         self.work_scheduler = WorkScheduler(clock)
         self.herder = Herder(self)
         self.overlay_manager = None   # wired by overlay.setup (optional)
+        from ..process import ProcessManager
+
+        # before HistoryManager: command-template archives transfer
+        # through the process manager
+        self.process_manager = ProcessManager(
+            self, config.MAX_CONCURRENT_SUBPROCESSES)
         from ..history import HistoryManager
 
         self.history_manager = HistoryManager(self)
         from ..catchup import CatchupManager
 
         self.catchup_manager = CatchupManager(self)
-        from ..process import ProcessManager
-
-        self.process_manager = ProcessManager(
-            self, config.MAX_CONCURRENT_SUBPROCESSES)
         self._meta_stream: List = []
         self._started = False
         # real-socket mode (enable_tcp): io service + listeners

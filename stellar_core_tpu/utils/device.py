@@ -1,0 +1,69 @@
+"""Device auto-detection for the default-on TPU paths (VERDICT r3 #2).
+
+A TPU-native node should use the TPU without flags: configs default the
+crypto / SCP-tally backends to "auto", and the Application resolves them
+here at construction.  The probe runs in a SUBPROCESS because a wedged
+TPU relay blocks ``jax.devices()`` indefinitely and cannot be interrupted
+in-process — and the probe child is NEVER killed: killing a client
+mid-handshake re-wedges the exclusive relay for every later client
+(round-3 postmortem, .claude/skills/verify/SKILL.md).  On timeout the
+child is left to finish on its own and the node boots on the CPU tier.
+
+The result is cached process-wide: one probe per process no matter how
+many Applications are constructed (the in-process Simulation harness
+builds dozens).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+_lock = threading.Lock()
+_result: Optional[bool] = None
+
+
+class DeviceProbe:
+    """ONE probe subprocess, never killed.  ``wait`` returns True (an
+    accelerator answered), False (probe exited without one), or None
+    (still pending — the child is left running, NOT killed)."""
+
+    def __init__(self):
+        env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+        self.started = time.monotonic()
+        try:
+            self.proc: Optional[subprocess.Popen] = subprocess.Popen(
+                [sys.executable, "-c",
+                 "import jax; assert jax.devices()[0].platform != 'cpu'"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                env=env)
+        except OSError:
+            self.proc = None
+
+    def wait(self, budget: float) -> Optional[bool]:
+        if self.proc is None:
+            return False
+        try:
+            return self.proc.wait(budget) == 0
+        except subprocess.TimeoutExpired:
+            return None  # leave the probe running; do NOT kill it
+
+
+def device_available(timeout: float = 10.0) -> bool:
+    """True iff a JAX accelerator backend initializes within ``timeout``
+    seconds (probed once per process; the probe child is never killed)."""
+    global _result
+    with _lock:
+        if _result is not None:
+            return _result
+        _result = DeviceProbe().wait(timeout) is True
+        return _result
+
+
+def _reset_for_tests() -> None:
+    global _result
+    with _lock:
+        _result = None

@@ -75,6 +75,8 @@ manual_close = false
 artificially_accelerate_time_for_testing = true
 exp_ledger_timespan_seconds = 1.0
 invariant_checks = [".*"]
+crypto_backend = "cpu"
+scp_tally_backend = "host"
 
 [quorum_set]
 threshold = 2
