@@ -125,6 +125,11 @@ class Bucket:
         (native/bucket_merge.cpp — the reference's background-worker
         compute tier); small ones and toolchain-less hosts use the
         Python loop, which is also the differential oracle."""
+        # empty-side fast paths: no collisions possible, entries unchanged
+        if not older.entries:
+            return newer
+        if not newer.entries:
+            return older
         if len(newer) + len(older) >= 256:
             out = _native_merge(newer, older)
             if out is not None:
@@ -244,8 +249,21 @@ class BucketLevel:
 
 
 class BucketList:
-    def __init__(self):
+    def __init__(self, executor=None):
         self.levels = [BucketLevel() for _ in range(NUM_LEVELS)]
+        # FutureBucket equivalent (ref src/bucket/FutureBucket.cpp): a
+        # level's next spill-merge inputs are fully determined at its
+        # PREVIOUS spill (snap and next.curr only change then), so the
+        # merge runs on a worker thread during the half-capacity window
+        # between spills and is resolved at spill time.  Unlike the
+        # reference — whose in-flight merges commit one spill late and
+        # therefore shape the canonical hash schedule — results here are
+        # bitwise identical to the synchronous merge, so the hash chain
+        # does not depend on whether (or when) backgrounding happened:
+        # restart-mid-merge simply falls back to the synchronous path.
+        self.executor = executor
+        # level -> (snap_ref, curr_ref, future)
+        self._futures: Dict[int, Tuple[Bucket, Bucket, object]] = {}
 
     def hash(self) -> bytes:
         """Cumulative commitment: sha256 over all level hashes
@@ -257,18 +275,57 @@ class BucketList:
                   ledger_version: int = 19) -> bytes:
         """Fold one close's delta in; cascade spills (ref addBatch
         BucketList.h:507).  Returns the new cumulative hash."""
+        spilled: List[int] = []
         # cascade from deepest to shallowest so spills don't double-move
         for level in range(NUM_LEVELS - 2, -1, -1):
             if level_should_spill(ledger_seq, level):
                 lv = self.levels[level]
                 nxt = self.levels[level + 1]
                 # snap spills into next.curr (merge); curr becomes snap
-                nxt.curr = Bucket.merge(lv.snap, nxt.curr)
+                nxt.curr = self._resolve_merge(level, lv.snap, nxt.curr)
                 lv.snap = lv.curr
                 lv.curr = Bucket()
+                spilled.append(level)
         fresh = Bucket.fresh(changes, ledger_version)
         self.levels[0].curr = Bucket.merge(fresh, self.levels[0].curr)
+        if self.executor is not None:
+            for level in spilled:
+                # this level's next spill: if level+1 spills at the same
+                # seq (every 4th time — half(L+1) = 4*half(L)), the
+                # cascade empties next.curr first and the staged merge
+                # would be discarded by the identity check; don't stage
+                # doomed work
+                nxt_spill = ledger_seq + level_half(level)
+                if nxt_spill % level_half(level + 1) == 0:
+                    continue
+                snap = self.levels[level].snap
+                curr = self.levels[level + 1].curr
+                if snap.entries and curr.entries:
+                    self._futures[level] = (
+                        snap, curr,
+                        self.executor.submit(self._bg_merge, snap, curr))
         return self.hash()
+
+    def _resolve_merge(self, level: int, snap: Bucket,
+                       curr: Bucket) -> Bucket:
+        """Use the background merge started at this level's previous
+        spill when its captured inputs are still the live ones; fall back
+        to a synchronous merge otherwise (first spill after construction
+        or restart, or a coincident deeper spill that replaced
+        next.curr — every 4th spill, where the fallback is a cheap merge
+        with an empty bucket)."""
+        staged = self._futures.pop(level, None)
+        if staged is not None:
+            snap_ref, curr_ref, fut = staged
+            if snap_ref is snap and curr_ref is curr:
+                return fut.result()
+        return Bucket.merge(snap, curr)
+
+    @staticmethod
+    def _bg_merge(newer: Bucket, older: Bucket) -> Bucket:
+        out = Bucket.merge(newer, older)
+        out.hash()  # pre-hash too: off the close critical path
+        return out
 
     # -- state access (catchup / BucketListDB-style lookups) ----------------
 
@@ -358,7 +415,17 @@ class BucketManager:
 
     def __init__(self, app=None, bucket_dir: Optional[str] = None):
         self.app = app
-        self.bucket_list = BucketList()
+        use_bg = bool(getattr(getattr(app, "config", None),
+                              "BACKGROUND_BUCKET_MERGES", False))
+        self.executor = None
+        if use_bg:
+            from concurrent.futures import ThreadPoolExecutor
+
+            # the reference's merge worker pool (ApplicationImpl worker
+            # threads cranking FutureBucket merges)
+            self.executor = ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="bucket-merge")
+        self.bucket_list = BucketList(self.executor)
         self.bucket_dir = bucket_dir
         if bucket_dir:
             import os
@@ -437,11 +504,17 @@ class BucketManager:
             self, level_hashes: Sequence[Tuple[str, str]]) -> None:
         self.bucket_list = BucketList.restore(
             level_hashes, self.load_bucket_bytes)
+        self.bucket_list.executor = self.executor
         self._saved = {hh for pair in level_hashes for hh in pair
                        if hh != "00" * 32}
 
     def assume_bucket_list(self, bucket_list: BucketList) -> None:
         """Adopt a bucket list built by catchup; persist its buckets."""
         self.bucket_list = bucket_list
+        self.bucket_list.executor = self.executor
         if self.bucket_dir:
             self._persist_new_buckets()
+
+    def shutdown(self) -> None:
+        if self.executor is not None:
+            self.executor.shutdown(wait=False, cancel_futures=True)

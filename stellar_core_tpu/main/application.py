@@ -202,6 +202,7 @@ class Application:
 
     def graceful_stop(self) -> None:
         self.process_manager.shutdown()
+        self.bucket_manager.shutdown()
         if self.overlay_manager is not None:
             self.overlay_manager.shutdown()
         if self.peer_door is not None:

@@ -144,6 +144,13 @@ class Config:
         # not need env flags to use the TPU (VERDICT r3 weak #3)
         self.CRYPTO_BACKEND: str = kw.get("CRYPTO_BACKEND", "auto")
 
+        # run spill-merges on worker threads between spills (FutureBucket,
+        # ref src/bucket/FutureBucket.cpp).  Results are bitwise identical
+        # to synchronous merges — this only moves latency off the close
+        # path — so the knob exists for debugging, not determinism.
+        self.BACKGROUND_BUCKET_MERGES: bool = kw.get(
+            "BACKGROUND_BUCKET_MERGES", True)
+
         # invariants
         self.INVARIANT_CHECKS: List[str] = kw.get("INVARIANT_CHECKS", [])
 

@@ -128,3 +128,64 @@ def test_delete_recreate_delete_stays_dead():
         bl.add_batch(seq, [(kb_of(f), f, False)])
         assert bl.get_entry(kb) is None, seq
     assert kb not in bl.all_live_entries()
+
+
+def test_background_merges_identical_hash_chain():
+    """FutureBucket-style background merges must produce the SAME hash at
+    every close as the synchronous path, and a restart mid-window (fresh
+    list, no staged futures) must continue the identical chain."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from stellar_core_tpu.bucket.bucket_list import Bucket
+
+    ex = ThreadPoolExecutor(max_workers=2)
+    sync_bl = BucketList()
+    bg_bl = BucketList(executor=ex)
+    restored = None  # created mid-stream from bg_bl's serialized state
+    hashes = []
+    for seq in range(1, 130):
+        changes = [(kb_of(acct(seq * 7 + j)), acct(seq * 7 + j), False)
+                   for j in range(3)]
+        # delete one key every few ledgers to exercise DEAD merges
+        if seq % 5 == 0:
+            e = acct((seq - 1) * 7)
+            changes.append((kb_of(e), None, True))
+        h1 = sync_bl.add_batch(seq, list(changes))
+        h2 = bg_bl.add_batch(seq, list(changes))
+        assert h1 == h2, f"divergence at seq {seq}"
+        if restored is not None:
+            h3 = restored.add_batch(seq, list(changes))
+            assert h3 == h1, f"restart divergence at seq {seq}"
+        if seq == 63:
+            # restart mid-window: serialize bg_bl (as a HAS + bucket
+            # store would), restore a fresh list — staged futures are
+            # gone, exactly like a process restart — and re-attach the
+            # executor so new futures stage from here on
+            store = {}
+            for lv in bg_bl.levels:
+                for b in (lv.curr, lv.snap):
+                    store[b.hash().hex()] = b.serialize()
+            restored = BucketList.restore(
+                bg_bl.level_hashes(),
+                lambda hh: store.get(hh))
+            restored.executor = ex
+            assert restored.hash() == h1
+            assert not restored._futures
+        hashes.append(h1)
+    assert len(set(hashes)) == len(hashes)  # every close moved the hash
+    ex.shutdown(wait=True)
+
+
+def test_bucket_manager_background_default_on(tmp_path):
+    from stellar_core_tpu.main import Application, test_config
+    from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), test_config())
+    assert app.bucket_manager.executor is not None
+    assert app.bucket_manager.bucket_list.executor is not None
+    app.graceful_stop()
+
+    app2 = Application(
+        VirtualClock(ClockMode.VIRTUAL_TIME),
+        test_config(BACKGROUND_BUCKET_MERGES=False))
+    assert app2.bucket_manager.executor is None
