@@ -153,8 +153,9 @@ class FeeBumpTransactionFrame:
     # -- fee + seqnum processing -------------------------------------------
 
     def process_fee_seq_num(self, ltx, base_fee: Optional[int]):
-        """Charge the fee to the FEE SOURCE; bump the INNER source's seqnum
-        (ref processFeeSeqNum)."""
+        """Charge the fee to the FEE SOURCE (ref processFeeSeqNum; the
+        INNER source's seqnum is consumed during the inner tx's apply,
+        like any protocol >= 10 transaction)."""
         header = ltx.header()
         fee = self.get_full_fee() if base_fee is None else min(
             self.get_full_fee(), base_fee * self.num_operations())
@@ -170,15 +171,6 @@ class FeeBumpTransactionFrame:
             inner.set_header(hdr)
             inner.put(entry._replace(data=T.LedgerEntryData.make(
                 T.LedgerEntryType.ACCOUNT, acc)))
-            # inner source seqnum consumption
-            src_entry = inner.load_account(self.inner_tx.source_account_id())
-            if src_entry is None:
-                raise RuntimeError("inner source vanished")
-            src = U.set_seq_info(
-                src_entry.data.value, self.inner_tx.seq_num(),
-                header.ledgerSeq, header.scpValue.closeTime)
-            inner.put(src_entry._replace(data=T.LedgerEntryData.make(
-                T.LedgerEntryType.ACCOUNT, src)))
             changes = inner.changes()
             inner.commit()
         return changes

@@ -340,8 +340,10 @@ class TransactionFrame:
     # -- fee + seqnum processing (ledger close phase 1) ---------------------
 
     def process_fee_seq_num(self, ltx, base_fee: Optional[int]) -> object:
-        """Charge the fee and bump the seqnum (ref processFeeSeqNum :1196).
-        Returns the fee-phase LedgerEntryChanges."""
+        """Charge the fee (ref processFeeSeqNum :1196 — at protocol >= 10
+        the sequence number is consumed during apply, not here; this
+        framework is protocol-19-only).  Returns the fee-phase
+        LedgerEntryChanges (the TransactionResultMeta.feeProcessing)."""
         header = ltx.header()
         fee = self.get_full_fee() if base_fee is None else min(
             self.get_full_fee(),
@@ -356,37 +358,125 @@ class TransactionFrame:
             acc = U.add_balance(acc, -charged)
             hdr = header._replace(feePool=header.feePool + charged)
             inner.set_header(hdr)
-            acc = U.set_seq_info(
-                acc, self.tx.seqNum, header.ledgerSeq,
-                header.scpValue.closeTime)
             inner.put(entry._replace(data=T.LedgerEntryData.make(
                 T.LedgerEntryType.ACCOUNT, acc)))
             changes = inner.changes()
             inner.commit()
         return changes
 
+    def _process_seq_num(self, ltx) -> None:
+        """Consume the sequence number + stamp seqLedger/seqTime (v3 ext)
+        (ref processSeqNum :1003 + maybeUpdateAccountOnLedgerSeqUpdate)."""
+        header = ltx.header()
+        entry = ltx.load_account(self.source_account_id())
+        acc = entry.data.value
+        if acc.seqNum > self.tx.seqNum:
+            raise RuntimeError("unexpected sequence number")
+        acc = U.set_seq_info(acc, self.tx.seqNum, header.ledgerSeq,
+                             header.scpValue.closeTime)
+        ltx.put(entry._replace(data=T.LedgerEntryData.make(
+            T.LedgerEntryType.ACCOUNT, acc)))
+
+    def _remove_one_time_signers(self, ltx) -> None:
+        """Remove this tx's pre-auth-tx signer from every source account
+        (ref removeOneTimeSignerFromAllSourceAccounts :1239 — runs during
+        apply whether or not the tx succeeds)."""
+        from . import sponsorship as SP
+
+        skey = T.SignerKey.make(
+            T.SignerKeyType.SIGNER_KEY_TYPE_PRE_AUTH_TX, self.full_hash())
+        skey_b = T.SignerKey.encode(skey)
+        accounts = {self.source_account_id()}
+        for opf in self.op_frames:
+            accounts.add(opf.source_account_id())
+        for aid in sorted(accounts):
+            entry = ltx.load_account(aid)
+            if entry is None:
+                continue  # removed by an earlier merge
+            acc = entry.data.value
+            signers = list(acc.signers)
+            idx = next((i for i, s in enumerate(signers)
+                        if T.SignerKey.encode(s.key) == skey_b), None)
+            if idx is None:
+                continue
+            sids = SP.signer_sponsoring_ids(acc)
+            old_sponsor = sids[idx].value if sids[idx] is not None else None
+            SP.release_signer_sponsorship(ltx, old_sponsor)
+            if old_sponsor is not None:
+                acc = SP.add_num_sponsored(acc, -1)
+            signers.pop(idx)
+            sids.pop(idx)
+            acc = acc._replace(numSubEntries=acc.numSubEntries - 1,
+                               signers=signers)
+            if any(s is not None for s in sids) or (
+                    acc.ext.type == 1 and acc.ext.value.ext.type == 2):
+                acc = SP.set_signer_sponsoring_ids(acc, sids)
+            ltx.put(entry._replace(data=T.LedgerEntryData.make(
+                T.LedgerEntryType.ACCOUNT, acc)))
+
     # -- apply (ledger close phase 2) --------------------------------------
 
     def apply(self, ltx, verify: Optional[Callable] = None,
               invariant_check: Optional[Callable] = None
               ) -> Tuple[bool, object, object]:
-        """Apply operations all-or-nothing (ref apply :1752 /
-        applyOperations :1388).  Returns (success, TransactionResult,
-        TransactionMeta-v2-value).  ``invariant_check(op_ltx, op_frame,
-        ok)`` runs against each OPERATION's isolated delta before its
-        commit (ref InvariantManager::checkOnOperationApply invoked from
+        """Apply (ref apply :1752 / applyOperations :1388).  Returns
+        (success, TransactionResult, TransactionMeta-v2-value).
+
+        Structure mirrors the reference's two-phase apply: a pre-ops
+        LedgerTxn consumes the sequence number (unless validation failed
+        before the seq stage — ref cv >= kInvalidUpdateSeqNum), runs
+        signature processing, and removes used pre-auth-tx signers; its
+        delta becomes the meta's txChangesBefore and COMMITS even when
+        the tx fails (a failed tx still burns its seqnum).  Operations
+        then apply all-or-nothing in their own layer.
+
+        ``invariant_check(op_ltx, op_frame, ok)`` runs against each
+        OPERATION's isolated delta before its commit (ref
+        InvariantManager::checkOnOperationApply from
         TransactionFrame.cpp:1441)."""
         checker = SignatureChecker(self.full_hash(), self.signatures, verify)
-        with LedgerTxn(ltx) as tx_ltx:
-            res = self.common_valid(tx_ltx, apply_seq=True, charge_fee=False)
+        with LedgerTxn(ltx) as pre_ltx:
+            res = self.common_valid(pre_ltx, apply_seq=True,
+                                    charge_fee=False)
+            if res not in _PRE_SEQNUM_CODES:
+                self._process_seq_num(pre_ltx)
+            sig_res = TC.txSUCCESS
+            ops_sig_results: Optional[List[object]] = None
             if res == TC.txSUCCESS:
-                res = self.process_signatures(tx_ltx, checker)
-            if res != TC.txSUCCESS:
-                tx_ltx.rollback()
-                self.result_code = res
-                return (False, self._make_result(res, []),
-                        _empty_meta())
+                sig_res = self.process_signatures(pre_ltx, checker)
+            if res == TC.txSUCCESS and sig_res == TC.txSUCCESS:
+                # op-level signature pre-check in a throwaway layer (ref
+                # processSignatures' allOpsValid loop :1049)
+                with LedgerTxn(pre_ltx) as probe:
+                    all_ok = True
+                    for opf in self.op_frames:
+                        if not opf.check_signatures(probe, checker):
+                            all_ok = False
+                    probe.rollback()
+                if not all_ok:
+                    ops_sig_results = [
+                        opf.result if opf.result is not None else
+                        T.OperationResult.make(
+                            T.OperationResultCode.opBAD_AUTH)
+                        for opf in self.op_frames]
+                elif not checker.check_all_signatures_used():
+                    sig_res = TC.txBAD_AUTH_EXTRA
+            self._remove_one_time_signers(pre_ltx)
+            changes_before = pre_ltx.changes()
+            pre_ltx.commit()
 
+        if res != TC.txSUCCESS or sig_res != TC.txSUCCESS:
+            code = res if res != TC.txSUCCESS else sig_res
+            self.result_code = code
+            return (False, self._make_result(code, []),
+                    _meta([], changes_before))
+        if ops_sig_results is not None:
+            self.result_code = TC.txFAILED
+            return (False,
+                    self._make_result(TC.txFAILED, ops_sig_results),
+                    _meta([], changes_before))
+
+        with LedgerTxn(ltx) as tx_ltx:
             op_results: List[object] = []
             op_metas: List[object] = []
             success = True
@@ -394,10 +484,6 @@ class TransactionFrame:
                 with LedgerTxn(tx_ltx) as op_ltx:
                     ok = opf.apply(op_ltx, checker)
                     if ok:
-                        # per-OPERATION invariants against this op's
-                        # isolated delta (ref InvariantManager::
-                        # checkOnOperationApply invoked from
-                        # TransactionFrame.cpp:1441)
                         if invariant_check is not None:
                             invariant_check(op_ltx, opf, True)
                         op_metas.append(T.OperationMeta.make(
@@ -409,13 +495,6 @@ class TransactionFrame:
                 op_results.append(opf.result)
                 if not success:
                     break
-            if success and not checker.check_all_signatures_used():
-                success = False
-                self.result_code = TC.txBAD_AUTH_EXTRA
-                tx_ltx.rollback()
-                return (False,
-                        self._make_result(TC.txBAD_AUTH_EXTRA, []),
-                        _empty_meta())
             if success:
                 # every BEGIN_SPONSORING_FUTURE_RESERVES must be closed by
                 # tx end (ref TransactionFrame applyOperations ->
@@ -428,14 +507,13 @@ class TransactionFrame:
                     tx_ltx.rollback()
                     return (False,
                             self._make_result(TC.txBAD_SPONSORSHIP, []),
-                            _empty_meta())
+                            _meta([], changes_before))
             if success:
                 tx_ltx.commit()
                 self.result_code = TC.txSUCCESS
-                # pad remaining results (loop never breaks on success)
                 return (True,
                         self._make_result(TC.txSUCCESS, op_results),
-                        _meta(op_metas))
+                        _meta(op_metas, changes_before))
             # failed: fill results for remaining unapplied ops
             while len(op_results) < len(self.op_frames):
                 idx = len(op_results)
@@ -447,7 +525,7 @@ class TransactionFrame:
             tx_ltx.rollback()
             self.result_code = TC.txFAILED
             return (False, self._make_result(TC.txFAILED, op_results),
-                    _empty_meta())
+                    _meta([], changes_before))
 
     def _make_result(self, code: int, op_results: List[object]) -> object:
         if code in (TC.txSUCCESS, TC.txFAILED):
@@ -465,13 +543,23 @@ class TransactionFrame:
             transactionHash=self.full_hash(), result=result)
 
 
-def _meta(op_metas: List[object]) -> object:
+def _meta(op_metas: List[object], changes_before=()) -> object:
     return T.TransactionMeta.make(2, T.TransactionMetaV2.make(
-        txChangesBefore=[], operations=op_metas, txChangesAfter=[]))
+        txChangesBefore=list(changes_before), operations=op_metas,
+        txChangesAfter=[]))
 
 
 def _empty_meta() -> object:
     return _meta([])
+
+
+# validity codes produced before the seqnum stage of commonValid — a tx
+# failing with one of these does NOT consume its sequence number at apply
+# (ref ValidationType::kInvalid vs kInvalidUpdateSeqNum)
+_PRE_SEQNUM_CODES = frozenset({
+    TC.txMISSING_OPERATION, TC.txMALFORMED, TC.txTOO_EARLY, TC.txTOO_LATE,
+    TC.txINSUFFICIENT_FEE, TC.txNO_ACCOUNT, TC.txBAD_SEQ,
+})
 
 
 def tx_frame_from_envelope(network_id: bytes, envelope):

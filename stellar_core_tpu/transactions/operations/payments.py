@@ -36,7 +36,12 @@ class CreateAccountOpFrame(OperationFrame):
         if ltx.load_account(dest) is not None:
             return self._res(C.CREATE_ACCOUNT_ALREADY_EXIST)
 
-        new_entry = U.make_account_entry(dest, self.body.startingBalance)
+        # new accounts start at seqNum = ledgerSeq << 32 (ref
+        # getStartingSequenceNumber — guarantees no replay of txs signed
+        # before the account existed)
+        new_entry = U.make_account_entry(
+            dest, self.body.startingBalance,
+            seq_num=header.ledgerSeq << 32)
         # reserve: paid by the new balance itself, or by the active sponsor
         # of the DESTINATION id (ref CreateAccountOpFrame::doApply ->
         # createEntryWithPossibleSponsorship with sponsoredID = dest)

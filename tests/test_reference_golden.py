@@ -1,0 +1,272 @@
+"""Parity against the REFERENCE's committed tx-meta baseline corpus
+(/root/reference/test-tx-meta-baseline-current/*.json — the BASELINE.md
+correctness gate: "bit-identical TxResults vs test-tx-meta-baseline-
+current").
+
+Each baseline file maps a Catch2 section path (e.g. "create account|
+protocol version 19|Success") to the 64-bit SipHash-2-4 of every
+NORMALIZED TransactionMeta recorded while that section ran (ref
+src/test/test.cpp:620 recordOrCheckGlobalTestTxMetadata;
+src/util/MetaUtils.cpp normalizeMeta; shortHash seeded from the file's
+"!rng seed" via ShortHash.cpp seed()).
+
+Reproducing a value requires replaying the reference test's exact
+fixtures — which ARE deterministic: test network passphrase
+"(V) (;,,;) (V)" (test.cpp), root key seeded by the network id, named
+accounts seeded by the name '.'-padded to 32 bytes (TxTests.cpp:574),
+genesis base fee 100 / base reserve 100000000 / maxTxSetSize 50 / total
+coins 10^18 (LedgerManagerImpl.cpp:88-93, Config.cpp:197-199), fee =
+100 * ops, and closes that keep closeTime at 0 (TxTests closeLedger
+reuses the last close time).  This file replays a set of scenarios
+through the REAL close path and asserts hash equality at protocol 19.
+
+Reproducibility notes for the rest of the corpus (VERDICT r4 task #7):
+scenarios whose fixtures use Catch2's PRNG (SecretKey::
+pseudoRandomForTesting, rng-seeded amounts) or TestMarket state are
+keyed to Catch2 internals and need those exact streams; everything
+fixture-deterministic (named accounts + constant amounts) is
+reconstructible the same way as the scenarios below.
+"""
+import base64
+import json
+import os
+
+import pytest
+
+from stellar_core_tpu.crypto import SecretKey, sha256
+from stellar_core_tpu.crypto.shorthash import siphash24
+from stellar_core_tpu.herder.tx_set import TxSetFrame
+from stellar_core_tpu.ledger.ledger_txn import entry_to_key, key_bytes
+from stellar_core_tpu.main import Application, test_config
+from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+from stellar_core_tpu.xdr import types as T
+
+REFERENCE_DIR = "/root/reference/test-tx-meta-baseline-current"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REFERENCE_DIR),
+    reason="reference baseline corpus not mounted")
+
+TEST_PASSPHRASE = "(V) (;,,;) (V)"  # ref test.cpp getTestConfig
+
+
+def load_baseline(name):
+    with open(os.path.join(REFERENCE_DIR, name)) as f:
+        return json.load(f)
+
+
+def shorthash_key(seed: int) -> bytes:
+    """ref ShortHash.cpp seed(): key[i] = byte of (seed >> (i % 4))."""
+    return bytes((seed >> (i % 4)) & 0xFF for i in range(16))
+
+
+# -- meta normalization (ref src/util/MetaUtils.cpp) ------------------------
+
+_TYPE_ORDER = {  # STATE first, then CREATED, UPDATED, REMOVED
+    T.LedgerEntryChangeType.LEDGER_ENTRY_STATE: 0,
+    T.LedgerEntryChangeType.LEDGER_ENTRY_CREATED: 1,
+    T.LedgerEntryChangeType.LEDGER_ENTRY_UPDATED: 2,
+    T.LedgerEntryChangeType.LEDGER_ENTRY_REMOVED: 3,
+}
+
+
+def _change_key(change) -> bytes:
+    if change.type == T.LedgerEntryChangeType.LEDGER_ENTRY_REMOVED:
+        return key_bytes(change.value)
+    return key_bytes(entry_to_key(change.value))
+
+
+def _sorted_changes(changes):
+    return sorted(changes, key=lambda c: (
+        _change_key(c), _TYPE_ORDER[c.type],
+        sha256(T.LedgerEntryChange.encode(c))))
+
+
+def normalize_meta(meta):
+    """Sorted-changes copy of a TransactionMeta (v2)."""
+    assert meta.type == 2
+    v2 = meta.value
+    ops = [T.OperationMeta.make(changes=_sorted_changes(om.changes))
+           for om in v2.operations]
+    return T.TransactionMeta.make(2, T.TransactionMetaV2.make(
+        txChangesBefore=_sorted_changes(v2.txChangesBefore),
+        operations=ops,
+        txChangesAfter=_sorted_changes(v2.txChangesAfter)))
+
+
+def meta_hash_b64(meta, rng_seed: int) -> str:
+    h = siphash24(shorthash_key(rng_seed),
+                  T.TransactionMeta.encode(normalize_meta(meta)))
+    # the corpus stores each uint64 base64'd in big-endian byte order
+    # (ref test.cpp saveTestTxMeta :815)
+    return base64.b64encode(h.to_bytes(8, "big")).decode()
+
+
+# -- reference test fixtures ------------------------------------------------
+
+def named_account_seed(name: str) -> bytes:
+    """ref txtest::getAccount: the name '.'-padded to 32 bytes IS the
+    ed25519 seed."""
+    return (name + "." * 32)[:32].encode()
+
+
+class RefHarness:
+    """A node configured exactly like the reference's createTestApplication
+    + getTestConfig, applying txs one per close with closeTime pinned at 0
+    (ref txtest::closeLedger reusing the last close time)."""
+
+    def __init__(self):
+        self.app = Application(
+            VirtualClock(ClockMode.VIRTUAL_TIME),
+            test_config(
+                NETWORK_PASSPHRASE=TEST_PASSPHRASE,
+                TESTING_UPGRADE_RESERVE=100000000,
+                TESTING_UPGRADE_MAX_TX_SET_SIZE=50,
+            ))
+        self.app.start()
+        self.root_sk = SecretKey(self.app.config.network_id())
+        self.base_reserve = 100000000
+        self.txfee = 100
+        self.seqs = {}  # account raw pubkey -> last seq consumed
+
+    def min_balance(self, entries: int) -> int:
+        return (2 + entries) * self.base_reserve
+
+    def _next_seq(self, pub: bytes) -> int:
+        from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+
+        if pub not in self.seqs:
+            with LedgerTxn(self.app.ledger_manager.root) as ltx:
+                e = ltx.load_account(pub)
+                self.seqs[pub] = e.data.value.seqNum
+                ltx.rollback()
+        self.seqs[pub] += 1
+        return self.seqs[pub]
+
+    def tx(self, sk: SecretKey, ops):
+        """transactionFromOperationsV1: fee = ops * 100, no memo/bounds."""
+        pub = sk.public_key().raw
+        tx = T.Transaction.make(
+            sourceAccount=T.MuxedAccount.make(
+                T.CryptoKeyType.KEY_TYPE_ED25519, pub),
+            fee=len(ops) * self.txfee,
+            seqNum=self._next_seq(pub),
+            cond=T.Preconditions.make(T.PreconditionType.PRECOND_NONE),
+            memo=T.Memo.make(T.MemoType.MEMO_NONE),
+            operations=ops,
+            ext=T.Transaction.fields[6][1].make(0))
+        payload = T.TransactionSignaturePayload.make(
+            networkId=self.app.config.network_id(),
+            taggedTransaction=T.TransactionSignaturePayload
+            .fields[1][1].make(T.EnvelopeType.ENVELOPE_TYPE_TX, tx))
+        sig = sk.sign(sha256(T.TransactionSignaturePayload.encode(payload)))
+        return T.TransactionEnvelope.make(
+            T.EnvelopeType.ENVELOPE_TYPE_TX,
+            T.TransactionV1Envelope.make(tx=tx, signatures=[
+                T.DecoratedSignature.make(hint=pub[-4:], signature=sig)]))
+
+    def op_create_account(self, dest_pub: bytes, balance: int):
+        return T.Operation.make(
+            sourceAccount=None,
+            body=T.Operation.fields[1][1].make(
+                T.OperationType.CREATE_ACCOUNT,
+                T.CreateAccountOp.make(
+                    destination=T.account_id(dest_pub),
+                    startingBalance=balance)))
+
+    def op_payment(self, dest_pub: bytes, amount: int, asset=None):
+        return T.Operation.make(
+            sourceAccount=None,
+            body=T.Operation.fields[1][1].make(
+                T.OperationType.PAYMENT,
+                T.PaymentOp.make(
+                    destination=T.MuxedAccount.make(
+                        T.CryptoKeyType.KEY_TYPE_ED25519, dest_pub),
+                    asset=(asset if asset is not None else
+                           T.Asset.make(T.AssetType.ASSET_TYPE_NATIVE)),
+                    amount=amount)))
+
+    def apply_tx(self, env):
+        """One tx in its own close, closeTime = last close time (stays 0);
+        returns (tx_result, TransactionMeta) from the real close path."""
+        lm = self.app.ledger_manager
+        seq = lm.last_closed_seq() + 1
+        prev = lm.last_closed_header()
+        xdr_set = T.TransactionSet.make(
+            previousLedgerHash=lm.last_closed_hash(), txs=[env])
+        tx_set = TxSetFrame.make_from_wire(
+            self.app.config.network_id(), xdr_set)
+        sv = T.StellarValue.make(
+            txSetHash=tx_set.contents_hash(),
+            closeTime=prev.scpValue.closeTime,
+            upgrades=[],
+            ext=T.StellarValue.fields[3][1].make(
+                T.StellarValueType.STELLAR_VALUE_BASIC))
+        from stellar_core_tpu.herder.herder import LedgerCloseData
+
+        lm.close_ledger(LedgerCloseData(seq, tx_set, sv))
+        cur = self.app.database.cursor()
+        row = cur.execute(
+            "SELECT txresult, txmeta FROM txhistory WHERE ledgerseq=? "
+            "ORDER BY txindex", (seq,)).fetchall()
+        assert len(row) == 1
+        result = T.TransactionResultPair.decode(row[0][0])
+        meta = T.TransactionMeta.decode(row[0][1])
+        return result, meta
+
+
+# -- scenarios --------------------------------------------------------------
+
+class TestCreateAccountBaselines:
+    """create account|protocol version 19|... scenarios from
+    CreateAccountTests.cpp, replayed step-for-step."""
+
+    def test_success(self):
+        d = load_baseline("CreateAccountTests.json")
+        seed = d["!rng seed"]
+        h = RefHarness()
+        b_sk = SecretKey(named_account_seed("B"))
+        res, meta = h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+            b_sk.public_key().raw, h.min_balance(0))]))
+        assert res.result.result.type == T.TransactionResultCode.txSUCCESS
+        got = meta_hash_b64(meta, seed)
+        want = d["create account|protocol version 19|Success"]
+        assert got == want[0], f"meta hash {got} != reference {want[0]}"
+
+    def test_success_account_already_exists(self):
+        d = load_baseline("CreateAccountTests.json")
+        seed = d["!rng seed"]
+        h = RefHarness()
+        b_sk = SecretKey(named_account_seed("B"))
+        h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+            b_sk.public_key().raw, h.min_balance(0))]))
+        res, meta = h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+            b_sk.public_key().raw, h.min_balance(0))]))
+        assert res.result.result.type == T.TransactionResultCode.txFAILED
+        op = res.result.result.value[0]
+        assert op.value.value.type == \
+            T.CreateAccountResultCode.CREATE_ACCOUNT_ALREADY_EXIST
+        got = meta_hash_b64(meta, seed)
+        want = d["create account|protocol version 19|Success|"
+                 "Account already exists"]
+        assert got == want[0]
+
+    def test_not_enough_funds(self):
+        d = load_baseline("CreateAccountTests.json")
+        seed = d["!rng seed"]
+        h = RefHarness()
+        gateway_payment = h.min_balance(2) + 10 * h.txfee + 1
+        gate_sk = SecretKey(named_account_seed("gate"))
+        _, meta1 = h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+            gate_sk.public_key().raw, gateway_payment)]))
+        res, meta2 = h.apply_tx(h.tx(gate_sk, [h.op_create_account(
+            SecretKey(named_account_seed("B")).public_key().raw,
+            gateway_payment)]))
+        assert res.result.result.type == T.TransactionResultCode.txFAILED
+        op = res.result.result.value[0]
+        assert op.value.value.type == \
+            T.CreateAccountResultCode.CREATE_ACCOUNT_UNDERFUNDED
+        want = d["create account|protocol version 19|"
+                 "Not enough funds (source)"]
+        assert [meta_hash_b64(meta1, seed),
+                meta_hash_b64(meta2, seed)] == want

@@ -277,12 +277,17 @@ class TestAccount:
 
     def apply(self, env, expect_success=True):
         """processFeeSeqNum + apply against the root, like one-tx ledger
-        close; returns (ok, result).  Handles fee-bump envelopes too."""
+        close; returns (ok, result).  Handles fee-bump envelopes too.
+        Each apply advances the ledger seq first, like a real close —
+        starting seqnums (ledgerSeq << 32) and merge SEQNUM_TOO_FAR
+        semantics depend on it."""
         from stellar_core_tpu.transactions.frame import \
             tx_frame_from_envelope
 
         frame = tx_frame_from_envelope(NETWORK_ID, env)
         with LedgerTxn(self.ledger.root_txn) as ltx:
+            hdr = ltx.header()
+            ltx.set_header(hdr._replace(ledgerSeq=hdr.ledgerSeq + 1))
             frame.process_fee_seq_num(ltx, base_fee=BASE_FEE)
             ok, result, meta = frame.apply(ltx)
             ltx.commit()
