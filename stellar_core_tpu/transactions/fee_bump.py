@@ -180,9 +180,11 @@ class FeeBumpTransactionFrame:
     def apply(self, ltx, verify: Optional[Callable] = None,
               invariant_check: Optional[Callable] = None
               ) -> Tuple[bool, object, object]:
-        """Apply the inner tx; wrap its result (ref apply :116)."""
+        """Apply the inner tx; wrap its result (ref apply :116 —
+        chargeFee=false: the outer fee source already paid)."""
         ok, inner_result, meta = self.inner_tx.apply(
-            ltx, verify=verify, invariant_check=invariant_check)
+            ltx, verify=verify, invariant_check=invariant_check,
+            charge_fee=False)
         self.result_code = (TC.txFEE_BUMP_INNER_SUCCESS if ok
                             else TC.txFEE_BUMP_INNER_FAILED)
         outer = self._wrap_result(inner_result)

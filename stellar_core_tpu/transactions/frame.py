@@ -21,6 +21,23 @@ from .signature_checker import SignatureChecker, account_signers
 
 TC = T.TransactionResultCode
 
+# ref TransactionFrame.h ValidationType: how far commonValid got — at
+# apply, cv >= kInvalidUpdateSeqNum still consumes the sequence number
+VT_INVALID = 0            # kInvalid
+VT_INVALID_UPD_SEQ = 1    # kInvalidUpdateSeqNum
+VT_INVALID_POST_AUTH = 2  # kInvalidPostAuth
+VT_MAYBE_VALID = 3        # kMaybeValid
+
+
+def _op_default_success(opf) -> object:
+    """The default-initialized opINNER result the reference gives ops whose
+    signatures passed in a tx failed by a sibling op's bad auth
+    (ref OperationFrame::resetResultSuccess + markResultFailed)."""
+    op_type = opf.op.body.type
+    return T.OperationResult.make(
+        T.OperationResultCode.opINNER,
+        T.OperationResultTr.default_for(op_type))
+
 
 class ValidationResult:
     def __init__(self, code: int, fee_charged: int = 0):
@@ -165,7 +182,7 @@ class TransactionFrame:
             if close_time + lower_bound_close_time_offset < tb.minTime:
                 return True
         lb = self._ledger_bounds()
-        if lb is not None and header.ledgerSeq + 1 < lb.minLedger:
+        if lb is not None and lb.minLedger > header.ledgerSeq:
             return True
         return False
 
@@ -177,7 +194,7 @@ class TransactionFrame:
                 return True
         lb = self._ledger_bounds()
         if lb is not None and lb.maxLedger and \
-                header.ledgerSeq + 1 >= lb.maxLedger:
+                lb.maxLedger <= header.ledgerSeq:
             return True
         return False
 
@@ -246,55 +263,75 @@ class TransactionFrame:
             return v2.minSeqNum <= base < self.tx.seqNum
         return base + 1 == self.tx.seqNum
 
-    def common_valid(self, ltx, apply_seq: bool, charge_fee: bool,
-                     current_seq: int = 0) -> int:
-        """ref commonValid (TransactionFrame.cpp:1105)."""
+    def _is_too_early_for_account(self, header, acc) -> bool:
+        """PreconditionsV2 minSeqAge / minSeqLedgerGap vs the account's
+        stamped seqTime/seqLedger (ref isTooEarlyForAccount :805 —
+        protocol >= 19, checked in BOTH validate and apply modes)."""
+        v2 = self._v2()
+        if v2 is None:
+            return False
+        if v2.minSeqAge:
+            close_time = header.scpValue.closeTime
+            if v2.minSeqAge > close_time or \
+                    close_time - v2.minSeqAge < U.seq_time(acc):
+                return True
+        if v2.minSeqLedgerGap:
+            if v2.minSeqLedgerGap > header.ledgerSeq or \
+                    header.ledgerSeq - v2.minSeqLedgerGap < \
+                    U.seq_ledger(acc):
+                return True
+        return False
+
+    def common_valid(self, ltx, checker: SignatureChecker, applying: bool,
+                     charge_fee: bool,
+                     current_seq: int = 0) -> Tuple[int, int]:
+        """ref commonValid (TransactionFrame.cpp:1104-1192).  Returns
+        ``(tier, code)`` where ``tier`` is the reference's ValidationType —
+        it decides whether a failing tx still consumes its sequence number
+        at apply (cv >= kInvalidUpdateSeqNum does; ref apply :1770-1772):
+
+          VT_INVALID          pre-seqnum failure or bad seq (no consume)
+          VT_INVALID_UPD_SEQ  too-early-for-account / bad auth
+          VT_INVALID_POST_AUTH insufficient balance
+          VT_MAYBE_VALID      all checks passed
+
+        The check ORDER matters for result-code parity: seq -> seq-age ->
+        tx-level auth -> extra signers -> balance."""
         res = self.common_valid_pre_seqnum(ltx, charge_fee)
         if res != TC.txSUCCESS:
-            return res
+            return VT_INVALID, res
         header = ltx.header()
         entry = ltx.load_account(self.source_account_id())
         acc = entry.data.value
-        # when applying (post processFeeSeqNum) the seqnum was already
-        # checked and consumed at the fee phase — skip the state checks
-        # (ref commonValid: applying && protocol >= 10)
-        if not apply_seq:
-            if not self._check_seq_num(acc, header, current_seq):
-                return TC.txBAD_SEQ
-            v2 = self._v2()
-            if v2 is not None:
-                if v2.minSeqAge:
-                    age = header.scpValue.closeTime - U.seq_time(acc)
-                    if age < v2.minSeqAge:
-                        return TC.txBAD_MIN_SEQ_AGE_OR_GAP
-                if v2.minSeqLedgerGap:
-                    gap = header.ledgerSeq + 1 - U.seq_ledger(acc)
-                    if gap < v2.minSeqLedgerGap:
-                        return TC.txBAD_MIN_SEQ_AGE_OR_GAP
-        if charge_fee:
-            # fee must be payable above the reserve
-            _, selling = U.account_liabilities(acc)
-            available = (acc.balance - selling
-                         - U.min_balance(header, acc))
-            if available < self.get_full_fee():
-                return TC.txINSUFFICIENT_BALANCE
-        return TC.txSUCCESS
-
-    def process_signatures(self, ltx, checker: SignatureChecker) -> int:
-        """Tx-level (fee-source low threshold) + extra-signers checks
-        (ref processSignatures :1022)."""
-        entry = ltx.load_account(self.source_account_id())
-        acc = entry.data.value
+        # bad-seq is re-checked when applying too (ref :1135-1148 — at
+        # protocol >= 10 the seqnum is consumed during apply, not at the
+        # fee phase, so the account seq is still the pre-tx value here; an
+        # earlier tx in the set may have bumped it past ours)
+        if not self._check_seq_num(acc, header, current_seq):
+            return VT_INVALID, TC.txBAD_SEQ
+        if self._is_too_early_for_account(header, acc):
+            return VT_INVALID_UPD_SEQ, TC.txBAD_MIN_SEQ_AGE_OR_GAP
         needed = U.threshold(acc, U.ThresholdLevel.LOW)
         if not checker.check_signature(account_signers(acc),
                                        max(needed, 1)):
-            return TC.txBAD_AUTH
+            return VT_INVALID_UPD_SEQ, TC.txBAD_AUTH
         v2 = self._v2()
         if v2 is not None:
             for skey in v2.extraSigners:
                 if not checker.check_signature([(skey, 1)], 1):
-                    return TC.txBAD_AUTH
-        return TC.txSUCCESS
+                    return VT_INVALID_UPD_SEQ, TC.txBAD_AUTH
+        if charge_fee:
+            # fee must be payable above the reserve; when applying the fee
+            # was already deducted at the fee phase, so only require the
+            # account not be below reserve+liabilities (ref feeToPay=0
+            # :1178-1190)
+            fee_to_pay = 0 if applying else self.get_full_fee()
+            _, selling = U.account_liabilities(acc)
+            available = (acc.balance - selling
+                         - U.min_balance(header, acc))
+            if available < fee_to_pay:
+                return VT_INVALID_POST_AUTH, TC.txINSUFFICIENT_BALANCE
+        return VT_MAYBE_VALID, TC.txSUCCESS
 
     def check_valid(self, ltx_parent, current_seq: int = 0,
                     verify: Optional[Callable] = None,
@@ -308,15 +345,10 @@ class TransactionFrame:
         with LedgerTxn(ltx_parent) as ltx:
             checker = SignatureChecker(
                 self.full_hash(), self.signatures, verify)
-            res = self.common_valid(ltx, apply_seq=False,
-                                    charge_fee=charge_fee,
-                                    current_seq=current_seq)
-            if res != TC.txSUCCESS:
-                self.result_code = res
-                ltx.rollback()
-                return ValidationResult(res)
-            res = self.process_signatures(ltx, checker)
-            if res != TC.txSUCCESS:
+            tier, res = self.common_valid(ltx, checker, applying=False,
+                                          charge_fee=charge_fee,
+                                          current_seq=current_seq)
+            if tier != VT_MAYBE_VALID:
                 self.result_code = res
                 ltx.rollback()
                 return ValidationResult(res)
@@ -417,8 +449,8 @@ class TransactionFrame:
     # -- apply (ledger close phase 2) --------------------------------------
 
     def apply(self, ltx, verify: Optional[Callable] = None,
-              invariant_check: Optional[Callable] = None
-              ) -> Tuple[bool, object, object]:
+              invariant_check: Optional[Callable] = None,
+              charge_fee: bool = True) -> Tuple[bool, object, object]:
         """Apply (ref apply :1752 / applyOperations :1388).  Returns
         (success, TransactionResult, TransactionMeta-v2-value).
 
@@ -436,44 +468,45 @@ class TransactionFrame:
         TransactionFrame.cpp:1441)."""
         checker = SignatureChecker(self.full_hash(), self.signatures, verify)
         with LedgerTxn(ltx) as pre_ltx:
-            res = self.common_valid(pre_ltx, apply_seq=True,
-                                    charge_fee=False)
-            if res not in _PRE_SEQNUM_CODES:
+            # charge_fee=False is the fee-bump inner-tx path (ref
+            # FeeBumpTransactionFrame::apply -> mInnerTx->apply with
+            # chargeFee=false): the outer tx paid, so the inner skips
+            # min-fee and balance checks at apply
+            tier, res = self.common_valid(pre_ltx, checker, applying=True,
+                                          charge_fee=charge_fee)
+            # a failing tx still consumes its seqnum unless validation
+            # failed at or before the seq stage (ref apply :1770-1772:
+            # cv >= kInvalidUpdateSeqNum -> processSeqNum)
+            if tier >= VT_INVALID_UPD_SEQ:
                 self._process_seq_num(pre_ltx)
-            sig_res = TC.txSUCCESS
             ops_sig_results: Optional[List[object]] = None
-            if res == TC.txSUCCESS:
-                sig_res = self.process_signatures(pre_ltx, checker)
-            if res == TC.txSUCCESS and sig_res == TC.txSUCCESS:
+            if tier == VT_MAYBE_VALID:
                 # op-level signature pre-check in a throwaway layer (ref
-                # processSignatures' allOpsValid loop :1049)
+                # processSignatures' allOpsValid loop :1049); only ops
+                # that actually fail are marked opBAD_AUTH — passing ops
+                # keep the default-initialized opINNER success result
+                # (ref OperationFrame::checkSignature :194 + markResultFailed)
                 with LedgerTxn(pre_ltx) as probe:
-                    all_ok = True
-                    for opf in self.op_frames:
-                        if not opf.check_signatures(probe, checker):
-                            all_ok = False
+                    failed = [not opf.check_signatures(probe, checker)
+                              for opf in self.op_frames]
                     probe.rollback()
-                if not all_ok:
+                if any(failed):
+                    res = TC.txFAILED
                     ops_sig_results = [
-                        opf.result if opf.result is not None else
                         T.OperationResult.make(
                             T.OperationResultCode.opBAD_AUTH)
-                        for opf in self.op_frames]
+                        if bad else _op_default_success(opf)
+                        for bad, opf in zip(failed, self.op_frames)]
                 elif not checker.check_all_signatures_used():
-                    sig_res = TC.txBAD_AUTH_EXTRA
+                    res = TC.txBAD_AUTH_EXTRA
             self._remove_one_time_signers(pre_ltx)
             changes_before = pre_ltx.changes()
             pre_ltx.commit()
 
-        if res != TC.txSUCCESS or sig_res != TC.txSUCCESS:
-            code = res if res != TC.txSUCCESS else sig_res
-            self.result_code = code
-            return (False, self._make_result(code, []),
-                    _meta([], changes_before))
-        if ops_sig_results is not None:
-            self.result_code = TC.txFAILED
+        if res != TC.txSUCCESS:
+            self.result_code = res
             return (False,
-                    self._make_result(TC.txFAILED, ops_sig_results),
+                    self._make_result(res, ops_sig_results or []),
                     _meta([], changes_before))
 
         with LedgerTxn(ltx) as tx_ltx:
@@ -551,15 +584,6 @@ def _meta(op_metas: List[object], changes_before=()) -> object:
 
 def _empty_meta() -> object:
     return _meta([])
-
-
-# validity codes produced before the seqnum stage of commonValid — a tx
-# failing with one of these does NOT consume its sequence number at apply
-# (ref ValidationType::kInvalid vs kInvalidUpdateSeqNum)
-_PRE_SEQNUM_CODES = frozenset({
-    TC.txMISSING_OPERATION, TC.txMALFORMED, TC.txTOO_EARLY, TC.txTOO_LATE,
-    TC.txINSUFFICIENT_FEE, TC.txNO_ACCOUNT, TC.txBAD_SEQ,
-})
 
 
 def tx_frame_from_envelope(network_id: bytes, envelope):

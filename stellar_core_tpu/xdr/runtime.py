@@ -77,6 +77,12 @@ class XdrType:
             raise XdrError("trailing bytes")
         return v
 
+    def default(self):
+        """The C++ default-constructed value of this type (ints/enums 0,
+        arrays empty, unions on arm 0) — what the reference's XDR result
+        fields hold before anything assigns them."""
+        raise NotImplementedError(type(self).__name__)
+
 
 def _pad(n: int) -> bytes:
     return b"\x00" * ((4 - n % 4) % 4)
@@ -93,6 +99,9 @@ class _IntBase(XdrType):
 
     def unpack(self, r):
         return struct.unpack(self.fmt, r.take(struct.calcsize(self.fmt)))[0]
+
+    def default(self):
+        return 0
 
 
 class IntType(_IntBase):
@@ -130,6 +139,9 @@ class BoolType(XdrType):
             raise XdrError("bad bool")
         return bool(x)
 
+    def default(self):
+        return False
+
 
 Bool = BoolType()
 
@@ -155,6 +167,9 @@ class Opaque(XdrType):
             raise XdrError("nonzero padding")
         return v
 
+    def default(self):
+        return b"\x00" * self.n
+
 
 class VarOpaque(XdrType):
     """opaque<max>."""
@@ -179,6 +194,9 @@ class VarOpaque(XdrType):
             raise XdrError("nonzero padding")
         return v
 
+    def default(self):
+        return b""
+
 
 class XdrStr(VarOpaque):
     """string<max> — kept as bytes (stellar strings are byte-exact)."""
@@ -197,6 +215,9 @@ class FixedArray(XdrType):
     def unpack(self, r):
         return [self.elem.unpack(r) for _ in range(self.n)]
 
+    def default(self):
+        return [self.elem.default() for _ in range(self.n)]
+
 
 class VarArray(XdrType):
     def __init__(self, elem: XdrType, max_len: int = 2**32 - 1):
@@ -214,6 +235,9 @@ class VarArray(XdrType):
         if n > self.max_len:
             raise XdrError("array too long")
         return [self.elem.unpack(r) for _ in range(n)]
+
+    def default(self):
+        return []
 
 
 class Option(XdrType):
@@ -234,6 +258,9 @@ class Option(XdrType):
         if flag not in (0, 1):
             raise XdrError("bad optional flag")
         return self.elem.unpack(r) if flag else None
+
+    def default(self):
+        return None
 
 
 class Enum(XdrType):
@@ -262,6 +289,9 @@ class Enum(XdrType):
 
     def nameof(self, v) -> str:
         return self.by_value[v]
+
+    def default(self):
+        return 0 if 0 in self.by_value else min(self.by_value)
 
 
 class _StructValue:
@@ -320,6 +350,10 @@ class Struct(XdrType):
         if unknown:
             raise XdrError(f"{self.name}: unknown fields {unknown}")
         return _StructValue(self.field_names, **kw)
+
+    def default(self):
+        return _StructValue(self.field_names,
+                            **{f: t.default() for f, t in self.fields})
 
     def pack(self, v, out):
         d = getattr(v, "__dict__", None)
@@ -393,18 +427,31 @@ class Union(XdrType):
         self.name = name
         self.disc = disc
         self.arms = dict(arms)
-        self.default = default
+        self._default_arm = default
 
     def _arm(self, d):
         if d in self.arms:
             return self.arms[d]
-        if self.default is not None:
-            return self.default
+        if self._default_arm is not None:
+            return self._default_arm
         raise XdrError(f"{self.name}: no arm for discriminant {d}")
 
     def make(self, d, value=None):
         arm_name, _ = self._arm(d)
         return _UnionValue(d, value, arm_name)
+
+    def default_for(self, d):
+        """Union set to discriminant ``d`` with a default-constructed arm
+        (the reference's ``u.type(d)`` on a fresh XDR union)."""
+        arm_name, arm_type = self._arm(d)
+        return _UnionValue(
+            d, arm_type.default() if arm_type is not None else None,
+            arm_name)
+
+    def default(self):
+        d = 0 if (0 in self.arms or self._default_arm is not None) else \
+            min(self.arms)
+        return self.default_for(d)
 
     memoize = False  # see Struct.memoize
 

@@ -80,11 +80,13 @@ def test_payment_no_destination(root):
 
 def test_seqnum_progression_and_bad_seq(root):
     a = root.create("alice", 100 * BASE_RESERVE)
-    assert a.loaded_seq() == 0
+    # new accounts start at ledgerSeq << 32 (ref TransactionUtils.cpp:984)
+    start = a.loaded_seq()
+    assert start == root.ledger.header().ledgerSeq << 32
     a.apply(a.tx([a.op_bump_seq(0)]))  # no-op bump
-    assert a.loaded_seq() == 1
+    assert a.loaded_seq() == start + 1
     # replay same seq -> bad seq at checkValid
-    env = a.tx([a.op_bump_seq(0)], seq=1)
+    env = a.tx([a.op_bump_seq(0)], seq=start + 1)
     res = a.check_valid(env)
     assert res.code == TC.txBAD_SEQ
 
@@ -230,6 +232,7 @@ def test_all_or_nothing_apply(root):
     bal_b = b.balance()
     ghost = SecretKey(sha256(b"ghost8")).public_key().raw
     # first op succeeds, second fails -> nothing applied
+    start = a.loaded_seq()
     env = a.tx([
         a.op_payment(b.account_id, 1000),
         a.op_payment(ghost, 1000),
@@ -239,7 +242,7 @@ def test_all_or_nothing_apply(root):
     assert result.result.type == TC.txFAILED
     assert b.balance() == bal_b  # rolled back
     # fee still charged, seq still bumped
-    assert a.loaded_seq() == 1
+    assert a.loaded_seq() == start + 1
 
 
 def test_credit_self_payment_is_noop(root):
@@ -258,3 +261,79 @@ def test_credit_self_payment_is_noop(root):
         tl = ltx.load_trustline(alice.account_id, usd)
         ltx.rollback()
     assert tl.data.value.balance == 500
+
+
+def test_apply_bad_seq_after_sibling_bump(root):
+    """Regression (ADVICE r4 high): a tx overtaken by an earlier tx in the
+    same set (BUMP_SEQUENCE on its own source) must fail cleanly at apply
+    with txBAD_SEQ — NOT crash — and must NOT consume its seqnum
+    (ref commonValid re-runs isBadSeq when applying,
+    TransactionFrame.cpp:1135-1148; cv==kInvalid skips processSeqNum
+    :1770-1772)."""
+    a = root.create("aseq", 100 * BASE_RESERVE)
+    start = a.loaded_seq()
+    env2 = a.tx([a.op_bump_seq(0)], seq=start + 2)  # built before the bump
+    a.apply(a.tx([a.op_bump_seq(start + 10)], seq=start + 1))
+    assert a.loaded_seq() == start + 10
+    ok, result = a.apply(env2, expect_success=False)
+    assert not ok
+    assert result.result.type == TC.txBAD_SEQ
+    assert a.loaded_seq() == start + 10  # not consumed
+
+
+def test_apply_min_seq_ledger_gap_consumes_seq(root):
+    """Regression (ADVICE r4 medium): minSeqLedgerGap is enforced at apply
+    too (ref isTooEarlyForAccount from commonValid :1152), and the failing
+    tx STILL consumes its sequence number (cv==kInvalidUpdateSeqNum)."""
+    a = root.create("agap", 100 * BASE_RESERVE)
+    start = a.loaded_seq()
+    a.apply(a.tx([a.op_bump_seq(0)]))  # stamps seqLedger via v3 ext
+    assert a.loaded_seq() == start + 1
+    cond = T.Preconditions.make(
+        T.PreconditionType.PRECOND_V2,
+        T.PreconditionsV2.make(
+            timeBounds=None, ledgerBounds=None, minSeqNum=None,
+            minSeqAge=0, minSeqLedgerGap=100, extraSigners=[]))
+    env = a.tx([a.op_bump_seq(0)], cond=cond, seq=start + 2)
+    ok, result = a.apply(env, expect_success=False)
+    assert not ok
+    assert result.result.type == TC.txBAD_MIN_SEQ_AGE_OR_GAP
+    assert a.loaded_seq() == start + 2  # consumed despite the failure
+
+
+def test_apply_partial_op_bad_auth_results(root):
+    """Regression (ADVICE r4 medium): in a multi-op tx failed by ONE op's
+    bad signature, only that op gets opBAD_AUTH; ops whose signatures
+    passed keep the default-initialized opINNER success result
+    (ref OperationFrame::checkSignature :194 + markResultFailed
+    :1063-1067)."""
+    a = root.create("amix", 100 * BASE_RESERVE)
+    b = root.create("bmix", 100 * BASE_RESERVE)
+    # op1: a pays b (signed); op2: sourced by b, b did NOT sign
+    op2 = a.op_payment(a.account_id, 1000)
+    op2 = op2._replace(sourceAccount=T.muxed_account(b.account_id))
+    env = a.tx([a.op_payment(b.account_id, 1000), op2])
+    ok, result = a.apply(env, expect_success=False)
+    assert not ok
+    assert result.result.type == TC.txFAILED
+    ops = result.result.value
+    OC = T.OperationResultCode
+    assert ops[0].type == OC.opINNER
+    assert ops[0].value.type == T.OperationType.PAYMENT
+    assert ops[0].value.value.type == \
+        T.PaymentResultCode.PAYMENT_SUCCESS
+    assert ops[1].type == OC.opBAD_AUTH
+
+
+def test_fee_bump_underpriced_inner_applies(root):
+    """Regression (r5 review): a fee-bump wrapping an inner tx whose own
+    fee is below the min fee must still APPLY successfully — the outer
+    source paid (ref FeeBumpTransactionFrame::apply -> mInnerTx->apply
+    with chargeFee=false)."""
+    a = root.create("afb", 100 * BASE_RESERVE)
+    b = root.create("bfb", 100 * BASE_RESERVE)
+    inner = a.tx([a.op_payment(b.account_id, 1000)], fee=1)
+    fb = a.fee_bump(inner, fee_source=b)
+    ok, result = b.apply(fb)
+    assert ok
+    assert result.result.type == TC.txFEE_BUMP_INNER_SUCCESS
