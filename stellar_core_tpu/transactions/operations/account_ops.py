@@ -59,14 +59,13 @@ class BumpSequenceOpFrame(OperationFrame):
         header = ltx.header()
         entry = self.load_source_account(ltx)
         acc = entry.data.value
-        max_seq = (header.ledgerSeq << 32) - 1
-        if self.body.bumpTo > max_seq:
-            return self._res(C.BUMP_SEQUENCE_BAD_SEQ)
-        if self.body.bumpTo > acc.seqNum:
-            acc = U.set_seq_info(
-                acc, self.body.bumpTo, header.ledgerSeq,
-                header.scpValue.closeTime)
-            _put_account(ltx, entry, acc)
+        # bump succeeds silently when bumpTo <= current; at v19 the
+        # seqLedger/seqTime stamp is written (and shows up in the meta)
+        # even for a no-op backward jump (ref BumpSequenceOpFrame.cpp:46-63)
+        new_seq = max(acc.seqNum, self.body.bumpTo)
+        acc = U.set_seq_info(acc, new_seq, header.ledgerSeq,
+                             header.scpValue.closeTime)
+        _put_account(ltx, entry, acc)
         return self._res(C.BUMP_SEQUENCE_SUCCESS)
 
 
@@ -608,7 +607,9 @@ class InflationOpFrame(OperationFrame):
         return op_inner(self.TYPE, T.InflationResult.make(
             code, payouts if code == 0 else None))
 
+    def is_supported(self, header) -> bool:
+        # ref InflationOpFrame::isOpSupported: protocol < 12 only
+        return header.ledgerVersion < 12
+
     def do_apply(self, ltx):
-        # protocol >= 12: inflation is disabled, always NOT_TIME
-        # (ref InflationOpFrame.cpp protocol gate)
         return self._res(T.InflationResultCode.INFLATION_NOT_TIME)

@@ -85,9 +85,18 @@ class OperationFrame:
         needed = U.threshold(acc, self.threshold_level())
         return checker.check_signature(account_signers(acc), max(needed, 1))
 
+    def is_supported(self, header) -> bool:
+        """ref OperationFrame::isOpSupported — checked FIRST, before
+        signatures (OperationFrame.cpp:240-245); INFLATION is the one
+        protocol-19 op that is no longer supported."""
+        return True
+
     def apply(self, ltx, checker) -> bool:
         """Auth + account existence + do_apply; returns success, with
         ``self.result`` holding the OperationResult."""
+        if not self.is_supported(ltx.header()):
+            self.result = op_error(T.OperationResultCode.opNOT_SUPPORTED)
+            return False
         if not self.check_signatures(ltx, checker):
             self.result = op_error(T.OperationResultCode.opBAD_AUTH)
             return False
@@ -102,6 +111,9 @@ class OperationFrame:
         return self._is_success(self.result)
 
     def check_valid(self, header) -> bool:
+        if not self.is_supported(header):
+            self.result = op_error(T.OperationResultCode.opNOT_SUPPORTED)
+            return False
         err = self.do_check_valid(header)
         if err is not None:
             self.result = err

@@ -80,50 +80,36 @@ class PaymentOpFrame(OperationFrame):
         return None
 
     def do_apply(self, ltx):
+        """Mirrors the reference's PathPaymentStrictReceive core with an
+        empty path: credit the DESTINATION first, then debit the SOURCE
+        re-reading through the ltx (a self-payment therefore nets to zero
+        through the same entry, and the meta records the touched entries
+        exactly like the reference's).  Ref PathPaymentStrictReceive
+        OpFrame::doApply + PathPaymentOpFrameBase::updateDestBalance
+        :213 / updateSourceBalance :142; check ORDER (dest LINE_FULL
+        before src UNDERFUNDED) is protocol-visible at v11+."""
         C = T.PaymentResultCode
         header = ltx.header()
         asset = self.body.asset
         amount = self.body.amount
         src_id = self.source_account_id()
         dest_id = U.muxed_to_account_id(self.body.destination)
+        issuer = None if U.is_native(asset) else U.asset_issuer(asset)
 
+        # dest-existence check is bypassed when sending credits straight
+        # back to their issuer (ref shouldBypassIssuerCheck)
+        bypass_issuer_check = issuer is not None and dest_id == issuer
+        if not bypass_issuer_check and ltx.load_account(dest_id) is None:
+            return self._res(C.PAYMENT_NO_DESTINATION)
+
+        # -- 1) credit the destination (ref updateDestBalance) -----------
         if U.is_native(asset):
             dest_entry = ltx.load_account(dest_id)
-            if dest_entry is None:
-                return self._res(C.PAYMENT_NO_DESTINATION)
-            if src_id == dest_id:
-                return self._res(C.PAYMENT_SUCCESS)  # self-payment no-op
-            src_entry = self.load_source_account(ltx)
-            src = src_entry.data.value
-            if U.get_available_balance(header, src) < amount:
-                return self._res(C.PAYMENT_UNDERFUNDED)
             dest = dest_entry.data.value
             if U.get_max_receive(header, dest) < amount:
                 return self._res(C.PAYMENT_LINE_FULL)
-            src = U.add_balance(src, -amount)
-            dest = U.add_balance(dest, amount)
-            put_account(ltx, src_entry, src)
-            put_account(ltx, dest_entry, dest)
-            return self._res(C.PAYMENT_SUCCESS)
-
-        # credit asset
-        issuer = U.asset_issuer(asset)
-        src_is_issuer = src_id == issuer
-        dest_is_issuer = dest_id == issuer
-        self_payment = src_id == dest_id
-
-        if not src_is_issuer:
-            tl_entry = ltx.load_trustline(src_id, asset)
-            if tl_entry is None:
-                return self._res(C.PAYMENT_SRC_NO_TRUST)
-            tl = tl_entry.data.value
-            if not U.is_authorized(tl):
-                return self._res(C.PAYMENT_SRC_NOT_AUTHORIZED)
-            if U.trustline_available_balance(tl) < amount:
-                return self._res(C.PAYMENT_UNDERFUNDED)
-        if not dest_is_issuer:
-            if ltx.load_account(dest_id) is None:
-                return self._res(C.PAYMENT_NO_DESTINATION)
+            put_account(ltx, dest_entry, U.add_balance(dest, amount))
+        elif dest_id != issuer:  # the issuer's line is infinite
             dtl_entry = ltx.load_trustline(dest_id, asset)
             if dtl_entry is None:
                 return self._res(C.PAYMENT_NO_TRUST)
@@ -132,20 +118,27 @@ class PaymentOpFrame(OperationFrame):
                 return self._res(C.PAYMENT_NOT_AUTHORIZED)
             if U.trustline_max_receive(dtl) < amount:
                 return self._res(C.PAYMENT_LINE_FULL)
+            put_trustline(ltx, dtl_entry,
+                          dtl._replace(balance=dtl.balance + amount))
 
-        if self_payment:
-            # src and dest share ONE trustline: writing both sides would
-            # overwrite the debit with the credit and mint money — all
-            # checks passed, net effect is zero
-            return self._res(C.PAYMENT_SUCCESS)
-        if not src_is_issuer:
-            tl = tl_entry.data.value._replace(
-                balance=tl_entry.data.value.balance - amount)
-            put_trustline(ltx, tl_entry, tl)
-        if not dest_is_issuer:
-            dtl = dtl_entry.data.value._replace(
-                balance=dtl_entry.data.value.balance + amount)
-            put_trustline(ltx, dtl_entry, dtl)
+        # -- 2) debit the source (ref updateSourceBalance) ---------------
+        if U.is_native(asset):
+            src_entry = ltx.load_account(src_id)  # re-read: may be dest
+            src = src_entry.data.value
+            if amount > U.get_available_balance(header, src):
+                return self._res(C.PAYMENT_UNDERFUNDED)
+            put_account(ltx, src_entry, U.add_balance(src, -amount))
+        elif src_id != issuer:
+            tl_entry = ltx.load_trustline(src_id, asset)
+            if tl_entry is None:
+                return self._res(C.PAYMENT_SRC_NO_TRUST)
+            tl = tl_entry.data.value
+            if not U.is_authorized(tl):
+                return self._res(C.PAYMENT_SRC_NOT_AUTHORIZED)
+            if U.trustline_available_balance(tl) < amount:
+                return self._res(C.PAYMENT_UNDERFUNDED)
+            put_trustline(ltx, tl_entry,
+                          tl._replace(balance=tl.balance - amount))
         return self._res(C.PAYMENT_SUCCESS)
 
 

@@ -143,14 +143,15 @@ class RefHarness:
         self.seqs[pub] += 1
         return self.seqs[pub]
 
-    def tx(self, sk: SecretKey, ops):
-        """transactionFromOperationsV1: fee = ops * 100, no memo/bounds."""
+    def tx(self, sk: SecretKey, ops, seq=None, extra_signers=()):
+        """transactionFromOperationsV1: fee = ops * 100, no memo/bounds.
+        ``extra_signers`` mirrors TestAccount::tx + addSignature."""
         pub = sk.public_key().raw
         tx = T.Transaction.make(
             sourceAccount=T.MuxedAccount.make(
                 T.CryptoKeyType.KEY_TYPE_ED25519, pub),
             fee=len(ops) * self.txfee,
-            seqNum=self._next_seq(pub),
+            seqNum=self._next_seq(pub) if seq is None else seq,
             cond=T.Preconditions.make(T.PreconditionType.PRECOND_NONE),
             memo=T.Memo.make(T.MemoType.MEMO_NONE),
             operations=ops,
@@ -159,20 +160,86 @@ class RefHarness:
             networkId=self.app.config.network_id(),
             taggedTransaction=T.TransactionSignaturePayload
             .fields[1][1].make(T.EnvelopeType.ENVELOPE_TYPE_TX, tx))
-        sig = sk.sign(sha256(T.TransactionSignaturePayload.encode(payload)))
+        h = sha256(T.TransactionSignaturePayload.encode(payload))
+        sigs = []
+        for signer in (sk, *extra_signers):
+            spub = signer.public_key().raw
+            sigs.append(T.DecoratedSignature.make(
+                hint=spub[-4:], signature=signer.sign(h)))
         return T.TransactionEnvelope.make(
             T.EnvelopeType.ENVELOPE_TYPE_TX,
-            T.TransactionV1Envelope.make(tx=tx, signatures=[
-                T.DecoratedSignature.make(hint=pub[-4:], signature=sig)]))
+            T.TransactionV1Envelope.make(tx=tx, signatures=sigs))
 
-    def op_create_account(self, dest_pub: bytes, balance: int):
+    # -- op builders (ref TxTests.cpp op factories) ------------------------
+
+    def _op(self, body_type, body_value=None, source: bytes = None):
         return T.Operation.make(
-            sourceAccount=None,
-            body=T.Operation.fields[1][1].make(
-                T.OperationType.CREATE_ACCOUNT,
-                T.CreateAccountOp.make(
-                    destination=T.account_id(dest_pub),
-                    startingBalance=balance)))
+            sourceAccount=(None if source is None else T.MuxedAccount.make(
+                T.CryptoKeyType.KEY_TYPE_ED25519, source)),
+            body=T.OperationBody.make(body_type, body_value))
+
+    def op_bump_seq(self, to: int, source=None):
+        return self._op(T.OperationType.BUMP_SEQUENCE,
+                        T.BumpSequenceOp.make(bumpTo=to), source)
+
+    def op_merge(self, dest_pub: bytes, source=None):
+        return self._op(T.OperationType.ACCOUNT_MERGE,
+                        T.MuxedAccount.make(
+                            T.CryptoKeyType.KEY_TYPE_ED25519, dest_pub),
+                        source)
+
+    def op_inflation(self, source=None):
+        return self._op(T.OperationType.INFLATION, None, source)
+
+    def op_change_trust(self, asset, limit: int, source=None):
+        return self._op(
+            T.OperationType.CHANGE_TRUST,
+            T.ChangeTrustOp.make(
+                line=T.ChangeTrustAsset.make(asset.type, asset.value),
+                limit=limit), source)
+
+    def op_manage_data(self, name: bytes, value, source=None):
+        return self._op(T.OperationType.MANAGE_DATA,
+                        T.ManageDataOp.make(dataName=name, dataValue=value),
+                        source)
+
+    def op_set_options(self, source=None, **kw):
+        return self._op(T.OperationType.SET_OPTIONS, T.SetOptionsOp.make(
+            inflationDest=kw.get("inflation_dest"),
+            clearFlags=kw.get("clear_flags"),
+            setFlags=kw.get("set_flags"),
+            masterWeight=kw.get("master_weight"),
+            lowThreshold=kw.get("low"),
+            medThreshold=kw.get("med"),
+            highThreshold=kw.get("high"),
+            homeDomain=kw.get("home_domain"),
+            signer=kw.get("signer")), source)
+
+    def op_manage_sell_offer(self, selling, buying, amount: int,
+                             price_n: int, price_d: int, offer_id: int = 0,
+                             source=None):
+        return self._op(T.OperationType.MANAGE_SELL_OFFER,
+                        T.ManageSellOfferOp.make(
+                            selling=selling, buying=buying, amount=amount,
+                            price=T.Price.make(n=price_n, d=price_d),
+                            offerID=offer_id), source)
+
+    def asset(self, issuer_pub: bytes, code: bytes):
+        """makeAsset: 4-char alphanum asset."""
+        return T.Asset.make(
+            T.AssetType.ASSET_TYPE_CREDIT_ALPHANUM4,
+            T.AlphaNum4.make(assetCode=code.ljust(4, b"\x00"),
+                             issuer=T.account_id(issuer_pub)))
+
+    def native(self):
+        return T.Asset.make(T.AssetType.ASSET_TYPE_NATIVE)
+
+    def op_create_account(self, dest_pub: bytes, balance: int,
+                          source=None):
+        return self._op(T.OperationType.CREATE_ACCOUNT,
+                        T.CreateAccountOp.make(
+                            destination=T.account_id(dest_pub),
+                            startingBalance=balance), source)
 
     def op_payment(self, dest_pub: bytes, amount: int, asset=None):
         return T.Operation.make(
@@ -185,6 +252,26 @@ class RefHarness:
                     asset=(asset if asset is not None else
                            T.Asset.make(T.AssetType.ASSET_TYPE_NATIVE)),
                     amount=amount)))
+
+    def close_empty(self, close_time=None):
+        """txtest::closeLedger(app) / closeLedgerOn with no txs."""
+        lm = self.app.ledger_manager
+        prev = lm.last_closed_header()
+        xdr_set = T.TransactionSet.make(
+            previousLedgerHash=lm.last_closed_hash(), txs=[])
+        tx_set = TxSetFrame.make_from_wire(
+            self.app.config.network_id(), xdr_set)
+        sv = T.StellarValue.make(
+            txSetHash=tx_set.contents_hash(),
+            closeTime=(prev.scpValue.closeTime if close_time is None
+                       else close_time),
+            upgrades=[],
+            ext=T.StellarValue.fields[3][1].make(
+                T.StellarValueType.STELLAR_VALUE_BASIC))
+        from stellar_core_tpu.herder.herder import LedgerCloseData
+
+        lm.close_ledger(LedgerCloseData(lm.last_closed_seq() + 1,
+                                        tx_set, sv))
 
     def apply_tx(self, env):
         """One tx in its own close, closeTime = last close time (stays 0);
@@ -270,3 +357,251 @@ class TestCreateAccountBaselines:
                  "Not enough funds (source)"]
         assert [meta_hash_b64(meta1, seed),
                 meta_hash_b64(meta2, seed)] == want
+
+
+def assert_section(d, key, metas):
+    """Assert the section's recorded hash list equals our replayed metas."""
+    seed = d["!rng seed"]
+    got = [meta_hash_b64(m, seed) for m in metas]
+    assert got == d[key], f"{key}: {got} != {d[key]}"
+
+
+INT64_MAX = 2**63 - 1
+
+
+class TestBumpSequenceBaselines:
+    """bump sequence|protocol version 19|... (BumpSequenceTests.cpp:26-101).
+    Fixture: A and B created with minBalance(0)+1000."""
+
+    def _fixture(self):
+        h = RefHarness()
+        a = SecretKey(named_account_seed("A"))
+        b = SecretKey(named_account_seed("B"))
+        for sk in (a, b):
+            h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+                sk.public_key().raw, h.min_balance(0) + 1000)]))
+        return h, a, b
+
+    def _seq(self, h, sk):
+        from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+
+        with LedgerTxn(h.app.ledger_manager.root) as ltx:
+            e = ltx.load_account(sk.public_key().raw)
+            ltx.rollback()
+        return e.data.value.seqNum
+
+    def test_small_bump(self):
+        d = load_baseline("BumpSequenceTests.json")
+        h, a, b = self._fixture()
+        new_seq = self._seq(h, a) + 2
+        _, meta = h.apply_tx(h.tx(a, [h.op_bump_seq(new_seq)]))
+        assert self._seq(h, a) == new_seq
+        assert_section(
+            d, "bump sequence|protocol version 19|test success|small bump",
+            [meta])
+
+    def test_large_bump_and_int64_max(self):
+        d = load_baseline("BumpSequenceTests.json")
+        h, a, b = self._fixture()
+        _, meta = h.apply_tx(h.tx(a, [h.op_bump_seq(INT64_MAX)]))
+        assert self._seq(h, a) == INT64_MAX
+        assert_section(
+            d, "bump sequence|protocol version 19|test success|large bump",
+            [meta])
+        # SequenceNumber::min() == 0 -> txBAD_SEQ, recorded anyway
+        res, meta2 = h.apply_tx(h.tx(
+            a, [h.op_payment(h.root_sk.public_key().raw, 1)], seq=0))
+        assert res.result.result.type == T.TransactionResultCode.txBAD_SEQ
+        assert_section(
+            d, "bump sequence|protocol version 19|test success|large bump|"
+               "no more tx when INT64_MAX is reached", [meta2])
+
+    def test_backward_jump_noop(self):
+        d = load_baseline("BumpSequenceTests.json")
+        h, a, b = self._fixture()
+        old = self._seq(h, a)
+        _, meta = h.apply_tx(h.tx(a, [h.op_bump_seq(1)]))
+        assert self._seq(h, a) == old + 1
+        assert_section(
+            d, "bump sequence|protocol version 19|test success|"
+               "backward jump (no-op)", [meta])
+
+    def test_bad_seq(self):
+        d = load_baseline("BumpSequenceTests.json")
+        h, a, b = self._fixture()
+        res1, m1 = h.apply_tx(h.tx(a, [h.op_bump_seq(-1)]))
+        res2, m2 = h.apply_tx(h.tx(a, [h.op_bump_seq(-(2**63))]))
+        for res in (res1, res2):
+            op = res.result.result.value[0]
+            assert op.value.value.type == \
+                T.BumpSequenceResultCode.BUMP_SEQUENCE_BAD_SEQ
+        assert_section(
+            d, "bump sequence|protocol version 19|test success|bad seq",
+            [m1, m2])
+
+    def test_seqnum_equals_starting_sequence(self):
+        d = load_baseline("BumpSequenceTests.json")
+        h, a, b = self._fixture()
+        ledger_seq = h.app.ledger_manager.last_closed_seq() + 2
+        new_seq = (ledger_seq << 32) - 1
+        _, m1 = h.apply_tx(h.tx(a, [h.op_bump_seq(new_seq)]))
+        assert self._seq(h, a) == new_seq
+        res, m2 = h.apply_tx(h.tx(
+            a, [h.op_payment(h.root_sk.public_key().raw, 1)]))
+        assert res.result.result.type == T.TransactionResultCode.txBAD_SEQ
+        assert_section(
+            d, "bump sequence|protocol version 19|"
+               "seqnum equals starting sequence", [m1, m2])
+
+
+class TestMergeBaselines:
+    """merge|protocol version 19|... (MergeTests.cpp:35-175).
+    Fixture: A (2*minBalance), B (minBalance), gate (minBalance) where
+    minBalance = getLastMinBalance(5) + 20*txfee."""
+
+    def _fixture(self):
+        h = RefHarness()
+        min_bal = h.min_balance(5) + 20 * h.txfee
+        a1 = SecretKey(named_account_seed("A"))
+        b1 = SecretKey(named_account_seed("B"))
+        gate = SecretKey(named_account_seed("gate"))
+        for sk, bal in ((a1, 2 * min_bal), (b1, min_bal), (gate, min_bal)):
+            h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+                sk.public_key().raw, bal)]))
+        return h, a1, b1
+
+    def test_merge_into_self(self):
+        d = load_baseline("MergeTests.json")
+        h, a1, b1 = self._fixture()
+        res, meta = h.apply_tx(h.tx(a1, [h.op_merge(a1.public_key().raw)]))
+        op = res.result.result.value[0]
+        assert op.value.value.type == \
+            T.AccountMergeResultCode.ACCOUNT_MERGE_MALFORMED
+        assert_section(d, "merge|protocol version 19|merge into self",
+                       [meta])
+
+    def test_merge_into_non_existent(self):
+        d = load_baseline("MergeTests.json")
+        h, a1, b1 = self._fixture()
+        c = SecretKey(named_account_seed("C"))
+        res, meta = h.apply_tx(h.tx(a1, [h.op_merge(c.public_key().raw)]))
+        op = res.result.result.value[0]
+        assert op.value.value.type == \
+            T.AccountMergeResultCode.ACCOUNT_MERGE_NO_ACCOUNT
+        assert_section(
+            d, "merge|protocol version 19|merge into non existent account",
+            [meta])
+
+    def test_with_create_seqnum_too_far(self):
+        """merge+create+merge in one tx: the re-merge hits
+        SEQNUM_TOO_FAR at protocol >= 10 (the account was just recreated
+        with a starting seqnum beyond the current ledger)."""
+        d = load_baseline("MergeTests.json")
+        h, a1, b1 = self._fixture()
+        create_balance = h.min_balance(1)
+        apub, bpub = a1.public_key().raw, b1.public_key().raw
+        env = h.tx(a1, [
+            h.op_merge(bpub, source=apub),
+            h.op_create_account(apub, create_balance, source=bpub),
+            h.op_merge(bpub, source=apub),
+        ], extra_signers=[b1])
+        res, meta = h.apply_tx(env)
+        assert res.result.result.type == T.TransactionResultCode.txFAILED
+        ops = res.result.result.value
+        assert ops[2].value.value.type == \
+            T.AccountMergeResultCode.ACCOUNT_MERGE_SEQNUM_TOO_FAR
+        assert_section(d, "merge|protocol version 19|with create", [meta])
+
+    def test_merge_create_merge_back(self):
+        d = load_baseline("MergeTests.json")
+        h, a1, b1 = self._fixture()
+        create_balance = h.min_balance(1)
+        apub, bpub = a1.public_key().raw, b1.public_key().raw
+        env = h.tx(a1, [
+            h.op_merge(bpub, source=apub),
+            h.op_create_account(apub, create_balance, source=bpub),
+            h.op_merge(apub, source=bpub),
+        ], extra_signers=[b1])
+        res, meta = h.apply_tx(env)
+        assert res.result.result.type == T.TransactionResultCode.txSUCCESS
+        from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+
+        with LedgerTxn(h.app.ledger_manager.root) as ltx:
+            e = ltx.load_account(apub)
+            assert ltx.load_account(bpub) is None
+            ltx.rollback()
+        # recreated with the starting seqnum of the applying ledger (5)
+        assert e.data.value.seqNum == 5 << 32
+        assert_section(
+            d, "merge|protocol version 19|merge, create, merge back",
+            [meta])
+
+
+class TestPaymentBaselines:
+    """payment|protocol version 19|... (PaymentTests.cpp:39-230,1890).
+    Fixture: A (minBalance2), gate + gate2 (minBalance2+morePayment)."""
+
+    def _fixture(self):
+        h = RefHarness()
+        min_balance2 = h.min_balance(2) + 10 * h.txfee
+        payment_amount = min_balance2
+        more_payment = payment_amount // 2
+        gateway_payment = min_balance2 + more_payment
+        a1 = SecretKey(named_account_seed("A"))
+        gate = SecretKey(named_account_seed("gate"))
+        gate2 = SecretKey(named_account_seed("gate2"))
+        for sk, bal in ((a1, payment_amount), (gate, gateway_payment),
+                        (gate2, gateway_payment)):
+            h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+                sk.public_key().raw, bal)]))
+        return h, a1, more_payment
+
+    def test_send_xlm_to_existing_account(self):
+        d = load_baseline("PaymentTests.json")
+        h, a1, more_payment = self._fixture()
+        res, meta = h.apply_tx(h.tx(h.root_sk, [h.op_payment(
+            a1.public_key().raw, more_payment)]))
+        assert res.result.result.type == T.TransactionResultCode.txSUCCESS
+        assert_section(
+            d, "payment|protocol version 19|send XLM to an existing account",
+            [meta])
+
+    def test_send_xlm_no_destination(self):
+        d = load_baseline("PaymentTests.json")
+        h, a1, _ = self._fixture()
+        b = SecretKey(named_account_seed("B"))
+        res, meta = h.apply_tx(h.tx(h.root_sk, [h.op_payment(
+            b.public_key().raw, h.min_balance(0))]))
+        op = res.result.result.value[0]
+        assert op.value.value.type == \
+            T.PaymentResultCode.PAYMENT_NO_DESTINATION
+        assert_section(
+            d, "payment|protocol version 19|"
+               "send XLM to a new account (no destination)", [meta])
+
+    def test_dest_amount_too_big(self):
+        d = load_baseline("PaymentTests.json")
+        h, a1, _ = self._fixture()
+        res, meta = h.apply_tx(h.tx(h.root_sk, [h.op_payment(
+            a1.public_key().raw, INT64_MAX)]))
+        op = res.result.result.value[0]
+        assert op.value.value.type == T.PaymentResultCode.PAYMENT_LINE_FULL
+        assert_section(
+            d, "payment|protocol version 19|"
+               "dest amount too big for native asset", [meta])
+
+
+class TestInflationBaselines:
+    """inflation|protocol version 19|not supported
+    (InflationTests.cpp:684-689): INFLATION returns opNOT_SUPPORTED at
+    protocol >= 12."""
+
+    def test_not_supported(self):
+        d = load_baseline("InflationTests.json")
+        h = RefHarness()
+        res, meta = h.apply_tx(h.tx(h.root_sk, [h.op_inflation()]))
+        assert res.result.result.type == T.TransactionResultCode.txFAILED
+        op = res.result.result.value[0]
+        assert op.type == T.OperationResultCode.opNOT_SUPPORTED
+        assert_section(
+            d, "inflation|protocol version 19|not supported", [meta])
