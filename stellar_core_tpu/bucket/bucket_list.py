@@ -162,6 +162,12 @@ class Bucket:
     def __len__(self) -> int:
         return len(self.entries)
 
+    def iter_entries(self):
+        return iter(self.entries)
+
+    def get(self, kb: bytes):
+        return _bucket_find(self, kb)
+
 
 def _native_merge(newer: "Bucket", older: "Bucket"):
     """Run the merge through native/bucket_merge.cpp; None if the native
@@ -237,6 +243,31 @@ def _merge_entry(new, old):
     return new
 
 
+def merge_buckets(newer, older, disk_dir: Optional[str] = None):
+    """Tier-dispatching merge: when ``disk_dir`` is set the result is a
+    DiskBucket built by a streaming merge (bounded memory); otherwise the
+    in-memory merge.  Mixed-tier inputs stream through iter_entries either
+    way; collision rules are the shared _merge_entry, so both tiers are
+    bitwise identical."""
+    from .disk_bucket import DiskBucket, merge_stream
+
+    if disk_dir is not None:
+        if older.is_empty() and isinstance(newer, DiskBucket):
+            return newer
+        if newer.is_empty() and isinstance(older, DiskBucket):
+            return older
+        return merge_stream(disk_dir, newer.iter_entries(),
+                            older.iter_entries(), _merge_entry)
+    if isinstance(newer, DiskBucket) or isinstance(older, DiskBucket):
+        # pulling a disk bucket back to memory happens only in small/test
+        # configurations; keep semantics identical
+        newer = newer if isinstance(newer, Bucket) else \
+            Bucket(tuple(newer.iter_entries()))
+        older = older if isinstance(older, Bucket) else \
+            Bucket(tuple(older.iter_entries()))
+    return Bucket.merge(newer, older)
+
+
 class BucketLevel:
     __slots__ = ("curr", "snap")
 
@@ -249,8 +280,20 @@ class BucketLevel:
 
 
 class BucketList:
-    def __init__(self, executor=None):
+    # levels >= DISK_LEVEL store their buckets on disk (sparse-indexed
+    # XDR files, bucket/disk_bucket.py) when a disk_dir is configured;
+    # shallower levels are small and stay in memory (ref BucketListDB:
+    # hot levels in memory, deep levels indexed files)
+    DISK_LEVEL = 4
+
+    def __init__(self, executor=None, disk_dir: Optional[str] = None,
+                 disk_level: Optional[int] = None):
         self.levels = [BucketLevel() for _ in range(NUM_LEVELS)]
+        self.disk_dir = disk_dir
+        if disk_level is not None:
+            self.disk_level = disk_level
+        else:
+            self.disk_level = self.DISK_LEVEL
         # FutureBucket equivalent (ref src/bucket/FutureBucket.cpp): a
         # level's next spill-merge inputs are fully determined at its
         # PREVIOUS spill (snap and next.curr only change then), so the
@@ -300,10 +343,11 @@ class BucketList:
                     continue
                 snap = self.levels[level].snap
                 curr = self.levels[level + 1].curr
-                if snap.entries and curr.entries:
+                if not snap.is_empty() and not curr.is_empty():
                     self._futures[level] = (
                         snap, curr,
-                        self.executor.submit(self._bg_merge, snap, curr))
+                        self.executor.submit(self._bg_merge, level,
+                                             snap, curr))
         return self.hash()
 
     def _resolve_merge(self, level: int, snap: Bucket,
@@ -319,11 +363,16 @@ class BucketList:
             snap_ref, curr_ref, fut = staged
             if snap_ref is snap and curr_ref is curr:
                 return fut.result()
-        return Bucket.merge(snap, curr)
+        return merge_buckets(snap, curr, self._merge_dir(level + 1))
 
-    @staticmethod
-    def _bg_merge(newer: Bucket, older: Bucket) -> Bucket:
-        out = Bucket.merge(newer, older)
+    def _merge_dir(self, target_level: int) -> Optional[str]:
+        """Directory for the merge result's tier (None = in-memory)."""
+        if self.disk_dir is not None and target_level >= self.disk_level:
+            return self.disk_dir
+        return None
+
+    def _bg_merge(self, level: int, newer, older):
+        out = merge_buckets(newer, older, self._merge_dir(level + 1))
         out.hash()  # pre-hash too: off the close critical path
         return out
 
@@ -335,27 +384,43 @@ class BucketList:
         BucketListDB design)."""
         for lv in self.levels:
             for bucket in (lv.curr, lv.snap):
-                e = _bucket_find(bucket, kb)
+                e = bucket.get(kb)
                 if e is not None:
                     if e.type == BET.DEADENTRY:
                         return None
                     return e.value
         return None
 
-    def all_live_entries(self) -> Dict[bytes, object]:
-        """Flatten to the live entry set (catchup's ApplyBucketsWork)."""
-        out: Dict[bytes, object] = {}
-        dead: set = set()
+    def iter_live_entries(self):
+        """Stream the live entry set in key order with O(#buckets) memory:
+        a heap-merge over all 22 sorted runs, shallower buckets shadowing
+        deeper ones per key (catchup's ApplyBucketsWork without
+        materializing the ledger; the whole point of the disk tier)."""
+        import heapq
+
+        def run(bucket, prio):
+            for kb, e in bucket.iter_entries():
+                yield kb, prio, e
+
+        runs = []
+        prio = 0
         for lv in self.levels:
             for bucket in (lv.curr, lv.snap):
-                for kb, e in bucket.entries:
-                    if kb in out or kb in dead:
-                        continue
-                    if e.type == BET.DEADENTRY:
-                        dead.add(kb)
-                    else:
-                        out[kb] = e.value
-        return out
+                if not bucket.is_empty():
+                    runs.append(run(bucket, prio))
+                prio += 1
+        cur_key = None
+        for kb, _, e in heapq.merge(*runs):
+            if kb == cur_key:
+                continue  # shadowed by a shallower bucket
+            cur_key = kb
+            if e.type != BET.DEADENTRY:
+                yield kb, e.value
+
+    def all_live_entries(self) -> Dict[bytes, object]:
+        """Flatten to the live entry set (small states / tests; catchup
+        streams via iter_live_entries)."""
+        return dict(self.iter_live_entries())
 
     # -- persistence / restore ---------------------------------------------
 
@@ -366,17 +431,29 @@ class BucketList:
 
     @classmethod
     def restore(cls, level_hashes: Sequence[Tuple[str, str]],
-                loader) -> "BucketList":
+                loader, disk_dir: Optional[str] = None,
+                disk_level: Optional[int] = None) -> "BucketList":
         """Rebuild from level hashes + a loader(hash_hex) -> bytes of the
         serialized bucket (ref AssumeStateWork restoring the bucket list
-        from a HAS)."""
-        bl = cls()
-        cache: Dict[str, Bucket] = {}
+        from a HAS).  With a disk_dir, deep levels whose files are already
+        in the store are INDEXED in place (DiskBucket.open) instead of
+        being materialized."""
+        from .disk_bucket import DiskBucket
 
-        def load(hh: str) -> Bucket:
+        bl = cls(disk_dir=disk_dir, disk_level=disk_level)
+        cache: Dict[str, object] = {}
+
+        def load(hh: str, level: int):
             if hh == "00" * 32:
                 return Bucket()
             if hh not in cache:
+                if disk_dir is not None and level >= bl.disk_level:
+                    import os
+
+                    path = os.path.join(disk_dir, f"bucket-{hh}.xdr")
+                    if os.path.exists(path):
+                        cache[hh] = DiskBucket.open(path, bytes.fromhex(hh))
+                        return cache[hh]
                 data = loader(hh)
                 if data is None:
                     raise RuntimeError(f"missing bucket {hh}")
@@ -390,9 +467,10 @@ class BucketList:
                 cache[hh] = b
             return cache[hh]
 
-        for lv, (ch, sh) in zip(bl.levels, level_hashes):
-            lv.curr = load(ch)
-            lv.snap = load(sh)
+        for level, (lv, (ch, sh)) in enumerate(
+                zip(bl.levels, level_hashes)):
+            lv.curr = load(ch, level)
+            lv.snap = load(sh, level)
         return bl
 
 
@@ -425,8 +503,11 @@ class BucketManager:
             # threads cranking FutureBucket merges)
             self.executor = ThreadPoolExecutor(
                 max_workers=2, thread_name_prefix="bucket-merge")
-        self.bucket_list = BucketList(self.executor)
         self.bucket_dir = bucket_dir
+        disk_level = getattr(getattr(app, "config", None),
+                             "DISK_BUCKET_LEVEL", None)
+        self.bucket_list = BucketList(self.executor, disk_dir=bucket_dir,
+                                      disk_level=disk_level)
         if bucket_dir:
             import os
 
@@ -461,10 +542,16 @@ class BucketManager:
         files."""
         import os
 
+        from .disk_bucket import DiskBucket
+
         for lv in self.bucket_list.levels:
             for b in (lv.curr, lv.snap):
                 hh = b.hash().hex()
                 if hh == "00" * 32 or hh in self._saved:
+                    continue
+                if isinstance(b, DiskBucket):
+                    # already a content-addressed file in the store
+                    self._saved.add(hh)
                     continue
                 path = self._bucket_path(hh)
                 if not os.path.exists(path):
@@ -484,10 +571,22 @@ class BucketManager:
         live = {b.hash().hex()
                 for lv in self.bucket_list.levels
                 for b in (lv.curr, lv.snap)}
-        for hh in list(self._saved - live):
+        # scan the directory (not just _saved): background merges write
+        # content-addressed files that may never be adopted (discarded
+        # futures, restarts) and would otherwise leak forever
+        try:
+            names = os.listdir(self.bucket_dir)
+        except OSError:
+            names = []
+        for name in names:
+            if not (name.startswith("bucket-") and name.endswith(".xdr")):
+                continue
+            hh = name[len("bucket-"):-len(".xdr")]
+            if hh in live:
+                continue
             self._saved.discard(hh)
             try:
-                os.remove(self._bucket_path(hh))
+                os.remove(os.path.join(self.bucket_dir, name))
             except OSError:
                 pass
 
@@ -503,15 +602,25 @@ class BucketManager:
     def restore_from_level_hashes(
             self, level_hashes: Sequence[Tuple[str, str]]) -> None:
         self.bucket_list = BucketList.restore(
-            level_hashes, self.load_bucket_bytes)
+            level_hashes, self.load_bucket_bytes,
+            disk_dir=self.bucket_dir,
+            disk_level=getattr(getattr(self.app, "config", None),
+                               "DISK_BUCKET_LEVEL", None))
         self.bucket_list.executor = self.executor
         self._saved = {hh for pair in level_hashes for hh in pair
                        if hh != "00" * 32}
 
     def assume_bucket_list(self, bucket_list: BucketList) -> None:
-        """Adopt a bucket list built by catchup; persist its buckets."""
+        """Adopt a bucket list built by catchup; persist its buckets and
+        re-attach the node's storage tier so later spill merges keep
+        going to disk."""
         self.bucket_list = bucket_list
         self.bucket_list.executor = self.executor
+        self.bucket_list.disk_dir = self.bucket_dir
+        disk_level = getattr(getattr(self.app, "config", None),
+                             "DISK_BUCKET_LEVEL", None)
+        if disk_level is not None:
+            self.bucket_list.disk_level = disk_level
         if self.bucket_dir:
             self._persist_new_buckets()
 

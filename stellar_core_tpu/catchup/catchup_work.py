@@ -114,8 +114,32 @@ class ApplyBucketsWork(BasicWork):
     def on_run(self) -> State:
         app = self.app
         level_hashes = [(b["curr"], b["snap"]) for b in self.has.buckets]
+        bm = app.bucket_manager
         try:
-            bl = BucketList.restore(level_hashes, self.archive.get_bucket)
+            # restore INTO the node's disk tier (downloaded deep buckets
+            # become indexed files, not RAM tuples); archive bytes are
+            # written through the bucket store first so DiskBucket.open
+            # can index in place
+            if bm.bucket_dir is not None:
+                import os
+
+                for pair in level_hashes:
+                    for hh in pair:
+                        if hh == "00" * 32:
+                            continue
+                        path = bm._bucket_path(hh)
+                        if not os.path.exists(path):
+                            data = self.archive.get_bucket(hh)
+                            if data is None:
+                                return State.FAILURE
+                            tmp = path + ".tmp"
+                            with open(tmp, "wb") as f:
+                                f.write(data)
+                            os.replace(tmp, path)
+            bl = BucketList.restore(
+                level_hashes, self.archive.get_bucket,
+                disk_dir=bm.bucket_dir,
+                disk_level=getattr(app.config, "DISK_BUCKET_LEVEL", None))
         except RuntimeError:
             return State.FAILURE
         header = self.header_entry.header
@@ -133,13 +157,24 @@ class ApplyBucketsWork(BasicWork):
             ltx.set_header(header)
             ltx.commit()
         app.ledger_manager.root._header_cache = None
-        live = bl.all_live_entries()
-        # invariants on bucket apply (ref checkOnBucketApply)
-        app.invariants.check_on_bucket_apply(live.values(), header)
-        with LedgerTxn(app.ledger_manager.root) as ltx:
-            for kb, entry in live.items():
-                ltx.put(entry)
-            ltx.commit()
+        # stream the live set (bounded memory: deep levels may be disk
+        # buckets far larger than RAM), applying in batches like the
+        # reference's BucketApplicator chunks
+        def flush(batch):
+            app.invariants.check_on_bucket_apply(batch, header)
+            with LedgerTxn(app.ledger_manager.root) as ltx:
+                for e in batch:
+                    ltx.put(e)
+                ltx.commit()
+
+        batch: list = []
+        for kb, entry in bl.iter_live_entries():
+            batch.append(entry)
+            if len(batch) >= 4096:
+                flush(batch)
+                batch = []
+        if batch:
+            flush(batch)
         # invariant: per-entry lastModified stamps were overwritten by
         # put(); re-put with original values would need raw writes — the
         # bucket hash above already attested the true state, and the SQL

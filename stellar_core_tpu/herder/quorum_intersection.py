@@ -23,6 +23,18 @@ per device program.  The early exits are the reference's
 There is no node cap: pruning keeps realistic (org-structured) topologies
 tractable exactly as in the reference, and an ``interrupt`` flag aborts
 long scans (ref InterruptedException).
+
+Tier policy (round-5 measurement, tools/quorum_tier_bench.py ->
+QUORUM_TIER_BENCH.json): on twisted majority cliques the NATIVE C++
+enumerator (native/quorum_enum.cpp) sustains ~1.1M subproblems/s vs
+~17k/s for the numpy enumerator and ~0.3k/s for the XLA batch contractor
+on host CPU — native wins by 60-3000x at every size measured, so it is
+the default evaluator wherever its shape limits allow.  The batched
+device contractor is NOT a performance tier on this hardware; it remains
+(a) the exact fallback for >2-level-nested qsets and >1024-node SCCs the
+native tier declines, and (b) the path a real multi-chip TPU deployment
+would re-measure.  Any "device kernel win" claim for quorum intersection
+is retired until a real-chip number exists.
 """
 from __future__ import annotations
 
@@ -312,12 +324,12 @@ class _MinQuorumEnumerator:
             if self.interrupt is not None and self.interrupt.is_set():
                 raise InterruptedError_()
             if self.max_calls and self.calls >= self.max_calls:
-                raise _BudgetExhausted()
+                raise _BudgetExhausted(self.calls)
             if self.deadline is not None:
                 import time as _time
 
                 if _time.monotonic() > self.deadline:
-                    raise _BudgetExhausted()
+                    raise _BudgetExhausted(self.calls)
             batch = stack[-BATCH:]
             del stack[-len(batch):]
             self.calls += len(batch)
@@ -637,11 +649,14 @@ def check_quorum_intersection(qmap: Dict[bytes, object],
 
         contractor = _Contractor(main_scc, qmap, use_device)
         if use_native:
-            # the native tier has no clock: convert the remaining wall
-            # budget to a call cap at its ~1M-calls/s throughput
+            # the native tier has no clock: convert the wall budget LEFT
+            # after the org-reduction attempt to a call cap at its ~1M
+            # calls/s throughput (ADVICE r4: the cap must shrink with
+            # elapsed time, and an abort must report actual calls)
             native_calls = max_calls
-            if max_seconds is not None:
-                time_cap = max(1, int(max_seconds * 1_000_000))
+            if deadline is not None:
+                remaining = max(0.0, deadline - _time.monotonic())
+                time_cap = max(1, int(remaining * 1_000_000))
                 native_calls = min(native_calls or time_cap, time_cap)
             native_res = _check_native(contractor, interrupt, native_calls)
             if native_res is not None:
@@ -660,8 +675,9 @@ def check_quorum_intersection(qmap: Dict[bytes, object],
         enum = _MinQuorumEnumerator(contractor, interrupt, max_calls,
                                     deadline)
         found = enum.run(np.ones(n, np.bool_))
-    except _BudgetExhausted:
-        return QuorumIntersectionResult(None, None, max_calls, n,
+    except _BudgetExhausted as exc:
+        scanned = exc.args[0] if exc.args else max_calls
+        return QuorumIntersectionResult(None, None, scanned, n,
                                         aborted=True)
     if found is not None:
         q1, q2 = found

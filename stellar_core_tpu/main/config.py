@@ -150,6 +150,10 @@ class Config:
         # path — so the knob exists for debugging, not determinism.
         self.BACKGROUND_BUCKET_MERGES: bool = kw.get(
             "BACKGROUND_BUCKET_MERGES", True)
+        # first bucket level stored as sparse-indexed files instead of in
+        # memory (ref BucketListDB; levels 0-3 hold <= 4^4 ledgers of
+        # deltas and stay hot)
+        self.DISK_BUCKET_LEVEL: int = kw.get("DISK_BUCKET_LEVEL", 4)
 
         # invariants
         self.INVARIANT_CHECKS: List[str] = kw.get("INVARIANT_CHECKS", [])
@@ -288,6 +292,18 @@ class Config:
                 a if isinstance(a, dict) else tuple(a)
                 for a in kw["HISTORY_ARCHIVES"]]
         cfg = cls(**kw)
+        # a file-configured node persists buckets next to its database by
+        # default (BUCKET_DIR_PATH resolved relative to the config file,
+        # like the reference's BUCKET_DIR_PATH); in-memory-DB nodes stay
+        # storeless unless an explicit real path is given
+        if cfg.BUCKET_DIR_PATH_REAL is None and cfg.DATABASE != ":memory:":
+            import os
+
+            base = os.path.dirname(os.path.abspath(path))
+            cfg.BUCKET_DIR_PATH_REAL = (
+                cfg.BUCKET_DIR_PATH
+                if os.path.isabs(cfg.BUCKET_DIR_PATH)
+                else os.path.join(base, cfg.BUCKET_DIR_PATH))
         cfg.validate()
         return cfg
 

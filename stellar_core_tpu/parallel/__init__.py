@@ -1,0 +1,46 @@
+"""Mesh + sharding layer for the device tier (SURVEY.md §2.17 P4-P6).
+
+Owns every ``jax.sharding`` decision in the framework so models and
+benches share one layout vocabulary:
+
+- ``data_parallel_mesh`` — the 1-D ``data`` mesh the admission pipeline
+  runs on (DP over signature batches, validator-parallel tallies);
+- ``dp``/``replicated`` — the two shardings the pipeline uses;
+
+The reference scales by flooding whole validators over TCP
+(src/overlay); the TPU-native analog shards work *within* a validator
+across the mesh and keeps the overlay for inter-validator traffic
+(SURVEY.md §5.8).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+
+
+def data_parallel_mesh(n_devices: Optional[int] = None,
+                       devices=None) -> Mesh:
+    """1-D mesh over ``n_devices`` (default: all) on axis ``data``."""
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (DATA_AXIS,))
+
+
+def dp(mesh: Mesh) -> NamedSharding:
+    """Leading axis split across ``data`` (signature batches, validator
+    axes)."""
+    return NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully replicated (small quorum tensors, statement matrices)."""
+    return NamedSharding(mesh, PartitionSpec())

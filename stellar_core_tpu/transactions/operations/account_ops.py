@@ -460,16 +460,26 @@ class AllowTrustOpFrame(OperationFrame):
         return op_inner(self.TYPE, T.AllowTrustResult.make(code))
 
     def do_check_valid(self, header):
+        """ref AllowTrustOpFrame::doCheckValid — all failures MALFORMED at
+        protocol 19 (authorize must be 0, AUTHORIZED_FLAG, or
+        AUTHORIZED_TO_MAINTAIN alone; both flags together invalid at v13+;
+        self-allow MALFORMED at v16+, replacing SELF_NOT_ALLOWED)."""
         C = T.AllowTrustResultCode
         b = self.body
         if b.asset.type == T.AssetType.ASSET_TYPE_NATIVE:
             return self._res(C.ALLOW_TRUST_MALFORMED)
-        mask = (T.AUTHORIZED_FLAG
-                | T.AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG)
-        if b.authorize & ~mask:
+        if b.authorize > T.AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG:
+            return self._res(C.ALLOW_TRUST_MALFORMED)
+        if b.asset.type == T.AssetType.ASSET_TYPE_CREDIT_ALPHANUM4:
+            full = U.asset_alphanum4(b.asset.value,
+                                     self.source_account_id())
+        else:
+            full = U.asset_alphanum12(b.asset.value,
+                                      self.source_account_id())
+        if not U.is_asset_valid(full):
             return self._res(C.ALLOW_TRUST_MALFORMED)
         if b.trustor.value == self.source_account_id():
-            return self._res(C.ALLOW_TRUST_SELF_NOT_ALLOWED)
+            return self._res(C.ALLOW_TRUST_MALFORMED)
         return None
 
     def do_apply(self, ltx):

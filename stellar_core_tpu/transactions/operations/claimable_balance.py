@@ -259,6 +259,11 @@ class ClawbackClaimableBalanceOpFrame(OperationFrame):
         if not cb_flags(cb) & T.CLAIMABLE_BALANCE_CLAWBACK_ENABLED_FLAG:
             return self._res(
                 C.CLAWBACK_CLAIMABLE_BALANCE_NOT_CLAWBACK_ENABLED)
+        # the reference loads the source account for the sponsorship
+        # release (doApply :37-40); the load is RECORDED, so the meta
+        # carries the (unchanged) source entry — mirror with a self-put
+        src_entry = self.load_source_account(ltx)
+        ltx.put(src_entry)
         SP.remove_entry_with_possible_sponsorship(ltx, entry, None)
         ltx.erase(entry_to_key(entry))
         return self._res(C.CLAWBACK_CLAIMABLE_BALANCE_SUCCESS)

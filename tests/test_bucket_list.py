@@ -189,3 +189,62 @@ def test_bucket_manager_background_default_on(tmp_path):
         VirtualClock(ClockMode.VIRTUAL_TIME),
         test_config(BACKGROUND_BUCKET_MERGES=False))
     assert app2.bucket_manager.executor is None
+
+
+def test_disk_tier_bitwise_parity(tmp_path):
+    """Disk-backed deep levels (DiskBucket + streaming merges) must give
+    the SAME cumulative hash, lookups, and live set as the in-memory
+    tier, and restore from the content-addressed files."""
+    import random
+
+    from stellar_core_tpu.bucket.bucket_list import BucketList
+    from stellar_core_tpu.bucket.disk_bucket import DiskBucket
+
+    rng = random.Random(5)
+    mem = BucketList()
+    disk = BucketList(disk_dir=str(tmp_path), disk_level=1)
+    keys = []
+    live = {}
+    for seq in range(2, 200):
+        changes = []
+        for _ in range(4):
+            i = rng.randrange(60)
+            entry = acct(i, balance=seq * 10 + i)
+            kb = kb_of(entry)
+            existed = kb in live
+            if existed and rng.random() < 0.25:
+                changes.append((kb, None, True))
+                live.pop(kb, None)
+            else:
+                changes.append((kb, entry, existed))
+                live[kb] = entry
+            keys.append(kb)
+        h1 = mem.add_batch(seq, list(changes))
+        h2 = disk.add_batch(seq, list(changes))
+        assert h1 == h2, f"hash diverged at seq {seq}"
+    # deep levels actually went to disk
+    assert any(
+        isinstance(b, DiskBucket) and not b.is_empty()
+        for lv in disk.levels[1:] for b in (lv.curr, lv.snap))
+    # lookups agree between tiers and with the model
+    for kb in set(keys):
+        assert disk.get_entry(kb) == mem.get_entry(kb)
+    got = dict(disk.iter_live_entries())
+    want = mem.all_live_entries()
+    assert got == want
+    # restore from level hashes + files reproduces the hash
+    def loader(hh):
+        import os
+        p = tmp_path / f"bucket-{hh}.xdr"
+        if p.exists():
+            return p.read_bytes()
+        # shallow (in-memory) buckets: reserialize from the live list
+        for lv in disk.levels:
+            for b in (lv.curr, lv.snap):
+                if b.hash().hex() == hh:
+                    return b.serialize()
+        return None
+
+    restored = BucketList.restore(disk.level_hashes(), loader,
+                                  disk_dir=str(tmp_path), disk_level=1)
+    assert restored.hash() == disk.hash()

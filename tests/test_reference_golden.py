@@ -605,3 +605,822 @@ class TestInflationBaselines:
         assert op.type == T.OperationResultCode.opNOT_SUPPORTED
         assert_section(
             d, "inflation|protocol version 19|not supported", [meta])
+
+
+class TestChangeTrustBaselines:
+    """change trust|protocol version 19|... (ChangeTrustTests.cpp:24-95).
+    Fixture: gw created with minBalance2; idr = gw's IDR."""
+
+    def _fixture(self):
+        h = RefHarness()
+        gw = SecretKey(named_account_seed("gw"))
+        h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+            gw.public_key().raw, h.min_balance(2))]))
+        return h, gw
+
+    def test_basic_tests(self):
+        d = load_baseline("ChangeTrustTests.json")
+        h, gw = self._fixture()
+        idr = h.asset(gw.public_key().raw, b"IDR")
+        root = h.root_sk
+        rpub = root.public_key().raw
+        metas = []
+        CC = T.ChangeTrustResultCode
+
+        def step(env, expect=None):
+            res, meta = h.apply_tx(env)
+            if expect is not None:
+                op = res.result.result.value[0]
+                assert op.value.value.type == expect, (
+                    f"got {op.value.value.type}, want {expect}")
+            metas.append(meta)
+
+        step(h.tx(root, [h.op_change_trust(idr, 0)]),
+             CC.CHANGE_TRUST_INVALID_LIMIT)
+        step(h.tx(root, [h.op_change_trust(idr, 100)]),
+             CC.CHANGE_TRUST_SUCCESS)
+        step(h.tx(gw, [h.op_payment(rpub, 90, asset=idr)]))
+        step(h.tx(root, [h.op_change_trust(idr, 89)]),
+             CC.CHANGE_TRUST_INVALID_LIMIT)
+        step(h.tx(root, [h.op_change_trust(idr, 0)]),
+             CC.CHANGE_TRUST_INVALID_LIMIT)
+        step(h.tx(root, [h.op_change_trust(idr, 90)]),
+             CC.CHANGE_TRUST_SUCCESS)
+        step(h.tx(root, [h.op_payment(gw.public_key().raw, 90, asset=idr)]))
+        step(h.tx(root, [h.op_change_trust(idr, 0)]),
+             CC.CHANGE_TRUST_SUCCESS)
+        assert_section(d, "change trust|protocol version 19|basic tests",
+                       metas)
+
+    def test_issuer_does_not_exist_new_trust_line(self):
+        d = load_baseline("ChangeTrustTests.json")
+        h, gw = self._fixture()
+        missing = SecretKey(named_account_seed("non-existing"))
+        usd = h.asset(missing.public_key().raw, b"IDR")
+        res, meta = h.apply_tx(h.tx(h.root_sk, [h.op_change_trust(
+            usd, 100)]))
+        op = res.result.result.value[0]
+        assert op.value.value.type == \
+            T.ChangeTrustResultCode.CHANGE_TRUST_NO_ISSUER
+        assert_section(
+            d, "change trust|protocol version 19|issuer does not exist|"
+               "new trust line", [meta])
+
+
+class TestManageDataBaselines:
+    """manage data|protocol version 19|create data with native buying
+    liabilities (ManageDataTests.cpp:146-161)."""
+
+    def test_create_data_native_buying_liabilities(self):
+        d = load_baseline("ManageDataTests.json")
+        h = RefHarness()
+        # top-level fixture (parent key): gw with minBalance(3)-100, then
+        # the versioned top-level manageData sequence
+        # (ManageDataTests.cpp:83-101 for_versions_from({2,4}))
+        gw = SecretKey(named_account_seed("gw"))
+        h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+            gw.public_key().raw, h.min_balance(3) - 100)]))
+        value = bytes(range(64))
+        value2 = bytes((n + 3) & 0xFF for n in range(64))
+        MD = T.ManageDataResultCode
+        for name, val, expect in (
+                (b"test", value, MD.MANAGE_DATA_SUCCESS),
+                (b"test2", value, MD.MANAGE_DATA_SUCCESS),
+                (b"test3", value, MD.MANAGE_DATA_LOW_RESERVE),
+                (b"test", value2, MD.MANAGE_DATA_SUCCESS),
+                (b"test", None, MD.MANAGE_DATA_SUCCESS),
+                (b"test3", value, MD.MANAGE_DATA_SUCCESS),
+                (b"test4", None, MD.MANAGE_DATA_NAME_NOT_FOUND)):
+            res, _ = h.apply_tx(h.tx(gw, [h.op_manage_data(name, val)]))
+            op = res.result.result.value[0]
+            assert op.value.value.type == expect
+        # section fixture (counts toward THIS key): acc1 + its offer
+        acc1 = SecretKey(named_account_seed("acc1"))
+        apub = acc1.public_key().raw
+        metas = []
+        _, m1 = h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+            apub, h.min_balance(2) + 2 * h.txfee + 500 - 1)]))
+        metas.append(m1)
+        cur1 = h.asset(apub, b"CUR1")
+        res, m2 = h.apply_tx(h.tx(acc1, [h.op_manage_sell_offer(
+            cur1, h.native(), 500, 1, 1)]))
+        assert res.result.result.type == T.TransactionResultCode.txSUCCESS
+        metas.append(m2)
+        value = bytes(range(64))
+        res, m3 = h.apply_tx(h.tx(acc1, [h.op_manage_data(b"test", value)]))
+        assert res.result.result.type == T.TransactionResultCode.txSUCCESS
+        metas.append(m3)
+        assert_section(
+            d, "manage data|protocol version 19|"
+               "create data with native buying liabilities", metas)
+
+
+class TestSetOptionsBaselines:
+    """set options|protocol version 19|... (SetOptionsTests.cpp:30-120,
+    581-609).  Fixture: A created with minBalance(0)+1000."""
+
+    def _fixture(self):
+        h = RefHarness()
+        a1 = SecretKey(named_account_seed("A"))
+        h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+            a1.public_key().raw, h.min_balance(0) + 1000)]))
+        return h, a1
+
+    def test_cant_set_and_clear_same_flag(self):
+        d = load_baseline("SetOptionsTests.json")
+        h, a1 = self._fixture()
+        res, meta = h.apply_tx(h.tx(a1, [h.op_set_options(
+            set_flags=1, clear_flags=1)]))  # AUTH_REQUIRED_FLAG
+        op = res.result.result.value[0]
+        assert op.value.value.type == \
+            T.SetOptionsResultCode.SET_OPTIONS_BAD_FLAGS
+        assert_section(
+            d, "set options|protocol version 19|flags|"
+               "Can't set and clear same flag", [meta])
+
+    def test_bad_weight_for_master_key(self):
+        d = load_baseline("SetOptionsTests.json")
+        h, a1 = self._fixture()
+        res, meta = h.apply_tx(h.tx(a1, [h.op_set_options(
+            master_weight=256)]))
+        op = res.result.result.value[0]
+        assert op.value.value.type == \
+            T.SetOptionsResultCode.SET_OPTIONS_THRESHOLD_OUT_OF_RANGE
+        assert_section(
+            d, "set options|protocol version 19|Signers|"
+               "bad weight for master key", [meta])
+
+    def test_signers_insufficient_balance(self):
+        d = load_baseline("SetOptionsTests.json")
+        h, a1 = self._fixture()
+        s1 = SecretKey(named_account_seed("S1"))
+        signer = T.Signer.make(
+            key=T.SignerKey.make(T.SignerKeyType.SIGNER_KEY_TYPE_ED25519,
+                                 s1.public_key().raw),
+            weight=1)
+        res, meta = h.apply_tx(h.tx(a1, [h.op_set_options(
+            master_weight=100, low=1, med=10, high=100, signer=signer)]))
+        op = res.result.result.value[0]
+        assert op.value.value.type == \
+            T.SetOptionsResultCode.SET_OPTIONS_LOW_RESERVE
+        assert_section(
+            d, "set options|protocol version 19|Signers|"
+               "insufficient balance", [meta])
+
+
+class TestTxResultsBaselines:
+    """txresults|protocol version 19|... (TxResultsTests.cpp:58-355).
+    Fixture: one empty close at 2016-01-01, then a..e (reserve*100),
+    g (minBalance0); f never created."""
+
+    def _fixture(self):
+        h = RefHarness()
+        h.close_empty(close_time=1451606400)  # getTestDate(1, 1, 2016)
+        start = h.base_reserve * 100
+        accs = {}
+        for name in ("a", "b", "c", "d", "e"):
+            accs[name] = SecretKey(named_account_seed(name))
+            h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+                accs[name].public_key().raw, start)]))
+        accs["g"] = SecretKey(named_account_seed("g"))
+        h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+            accs["g"].public_key().raw, h.min_balance(0))]))
+        return h, accs, start
+
+    def test_create_account_normal(self):
+        d = load_baseline("TxResultsTests.json")
+        h, accs, start = self._fixture()
+        f = SecretKey(named_account_seed("f"))
+        res, meta = h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+            f.public_key().raw, start)]))
+        assert res.result.result.type == T.TransactionResultCode.txSUCCESS
+        assert_section(
+            d, "txresults|protocol version 19|create account|normal",
+            [meta])
+
+    def test_merge_account_normal(self):
+        d = load_baseline("TxResultsTests.json")
+        h, accs, start = self._fixture()
+        res, meta = h.apply_tx(h.tx(accs["a"], [
+            h.op_payment(accs["b"].public_key().raw, 1000),
+            h.op_merge(h.root_sk.public_key().raw)]))
+        assert res.result.result.type == T.TransactionResultCode.txSUCCESS
+        ops = res.result.result.value
+        assert ops[1].value.value.value == start - 1200  # merged balance
+        assert_section(
+            d, "txresults|protocol version 19|merge account|normal",
+            [meta])
+
+
+class TestEndSponsoringBaselines:
+    """confirm and clear sponsor|protocol version 19|not sponsored
+    (EndSponsoringFutureReservesTests.cpp): the recorded meta is the
+    fixture create; the raw-apply differential (checkValid passes, apply
+    fails NOT_SPONSORED) is asserted against our frames directly."""
+
+    def test_not_sponsored(self):
+        d = load_baseline("EndSponsoringFutureReservesTests.json")
+        h = RefHarness()
+        a1 = SecretKey(named_account_seed("a1"))
+        _, meta = h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+            a1.public_key().raw, h.min_balance(0))]))
+        assert_section(
+            d, "confirm and clear sponsor|protocol version 19|not sponsored",
+            [meta])
+        # differential: END_SPONSORING with no begin -> checkValid OK,
+        # apply fails with NOT_SPONSORED (uncommitted, like the reference)
+        from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+        from stellar_core_tpu.transactions.frame import TransactionFrame
+
+        env = h.tx(h.root_sk, [h._op(
+            T.OperationType.END_SPONSORING_FUTURE_RESERVES)])
+        frame = TransactionFrame(h.app.config.network_id(), env)
+        with LedgerTxn(h.app.ledger_manager.root) as ltx:
+            assert frame.check_valid(ltx).ok
+            ok, result, _ = frame.apply(ltx)
+            ltx.rollback()
+        assert not ok
+        assert result.result.type == T.TransactionResultCode.txFAILED
+        op = result.result.value[0]
+        assert op.value.value.type == \
+            T.EndSponsoringFutureReservesResultCode.\
+            END_SPONSORING_FUTURE_RESERVES_NOT_SPONSORED
+
+
+class TestClawbackBaselines:
+    """clawback|protocol version 19|... (ClawbackTests.cpp:18-80).
+    Fixture: A1 + gw with minBalance3; idr = gw's IDR."""
+
+    def _fixture(self):
+        h = RefHarness()
+        a1 = SecretKey(named_account_seed("A1"))
+        gw = SecretKey(named_account_seed("gw"))
+        for sk in (a1, gw):
+            h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+                sk.public_key().raw, h.min_balance(3))]))
+        return h, a1, gw
+
+    def test_all_version_errors(self):
+        d = load_baseline("ClawbackTests.json")
+        h, a1, gw = self._fixture()
+        # allowTrust with TRUSTLINE_CLAWBACK_ENABLED_FLAG (4) is MALFORMED
+        op = h._op(T.OperationType.ALLOW_TRUST, T.AllowTrustOp.make(
+            trustor=T.account_id(a1.public_key().raw),
+            asset=T.AssetCode.make(
+                T.AssetType.ASSET_TYPE_CREDIT_ALPHANUM4, b"IDR\x00"),
+            authorize=4))
+        res, meta = h.apply_tx(h.tx(gw, [op]))
+        opr = res.result.result.value[0]
+        assert opr.value.value.type == \
+            T.AllowTrustResultCode.ALLOW_TRUST_MALFORMED
+        assert_section(
+            d, "clawback|protocol version 19|all version errors", [meta])
+
+    def test_from_v17_basic(self):
+        d = load_baseline("ClawbackTests.json")
+        h, a1, gw = self._fixture()
+        idr = h.asset(gw.public_key().raw, b"IDR")
+        apub = a1.public_key().raw
+        # from-V17 setup (parent key): clawback+revocable flags, trust, pay
+        h.apply_tx(h.tx(gw, [h.op_set_options(set_flags=0x8 | 0x2)]))
+        h.apply_tx(h.tx(a1, [h.op_change_trust(idr, 1000)]))
+        h.apply_tx(h.tx(gw, [h.op_payment(apub, 100, asset=idr)]))
+        claw = h._op(T.OperationType.CLAWBACK, T.ClawbackOp.make(
+            asset=idr,
+            from_=T.MuxedAccount.make(
+                T.CryptoKeyType.KEY_TYPE_ED25519, apub),
+            amount=75))
+        res, meta = h.apply_tx(h.tx(gw, [claw]))
+        opr = res.result.result.value[0]
+        assert opr.value.value.type == \
+            T.ClawbackResultCode.CLAWBACK_SUCCESS
+        from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+
+        with LedgerTxn(h.app.ledger_manager.root) as ltx:
+            tl = ltx.load_trustline(apub, idr)
+            ltx.rollback()
+        assert tl.data.value.balance == 25
+        assert_section(
+            d, "clawback|protocol version 19|from V17|basic test", [meta])
+
+
+class TestSetTrustLineFlagsBaselines:
+    """set trustline flags|protocol version 19|errors|no trust
+    (SetTrustLineFlagsTests.cpp:1-60,303-307)."""
+
+    def test_errors_no_trust(self):
+        d = load_baseline("SetTrustLineFlagsTests.json")
+        h = RefHarness()
+        gw = SecretKey(named_account_seed("gw"))
+        a1 = SecretKey(named_account_seed("A1"))
+        a2 = SecretKey(named_account_seed("A2"))
+        for sk, bal in ((gw, h.min_balance(4)),
+                        (a1, h.min_balance(4) + 10000),
+                        (a2, h.min_balance(4))):
+            h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+                sk.public_key().raw, bal)]))
+        idr = h.asset(gw.public_key().raw, b"IDR")
+        h.apply_tx(h.tx(gw, [h.op_set_options(set_flags=0x2)]))  # REVOCABLE
+        h.apply_tx(h.tx(a1, [h.op_change_trust(idr, INT64_MAX)]))
+        # leaf: setTrustLineFlags on a2 who has NO trustline
+        op = h._op(T.OperationType.SET_TRUST_LINE_FLAGS,
+                   T.SetTrustLineFlagsOp.make(
+                       trustor=T.account_id(a2.public_key().raw),
+                       asset=idr, clearFlags=0, setFlags=0))
+        res, meta = h.apply_tx(h.tx(gw, [op]))
+        opr = res.result.result.value[0]
+        assert opr.value.value.type == \
+            T.SetTrustLineFlagsResultCode.SET_TRUST_LINE_FLAGS_NO_TRUST_LINE
+        assert_section(
+            d, "set trustline flags|protocol version 19|errors|no trust",
+            [meta])
+
+
+class TestAllowTrustBaselines:
+    """authorized to maintain liabilities|protocol version 19|allow trust|
+    AUTHORIZED_FLAG and AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG can't be
+    used together (AllowTrustTests.cpp:80-305)."""
+
+    def test_auth_flags_cant_be_used_together(self):
+        d = load_baseline("AllowTrustTests.json")
+        h = RefHarness()
+        gw = SecretKey(named_account_seed("gw"))
+        a1 = SecretKey(named_account_seed("A1"))
+        a2 = SecretKey(named_account_seed("A2"))
+        gpub, apub = gw.public_key().raw, a1.public_key().raw
+        for sk, bal in ((gw, h.min_balance(4)),
+                        (a1, h.min_balance(4) + 10000),
+                        (a2, h.min_balance(4))):
+            h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+                sk.public_key().raw, bal)]))
+        # AUTH_REQUIRED | AUTH_REVOCABLE
+        h.apply_tx(h.tx(gw, [h.op_set_options(set_flags=0x1 | 0x2)]))
+        usd = h.asset(gpub, b"USD")
+        idr = h.asset(gpub, b"IDR")
+
+        def allow(asset_code, authorize, expect=None):
+            op = h._op(T.OperationType.ALLOW_TRUST, T.AllowTrustOp.make(
+                trustor=T.account_id(apub),
+                asset=T.AssetCode.make(
+                    T.AssetType.ASSET_TYPE_CREDIT_ALPHANUM4,
+                    asset_code.ljust(4, b"\x00")),
+                authorize=authorize))
+            res, meta = h.apply_tx(h.tx(gw, [op]))
+            opr = res.result.result.value[0]
+            if expect is not None:
+                assert opr.value.value.type == expect
+            return meta
+
+        h.apply_tx(h.tx(a1, [h.op_change_trust(usd, INT64_MAX)]))
+        allow(b"USD", 1, T.AllowTrustResultCode.ALLOW_TRUST_SUCCESS)
+        h.apply_tx(h.tx(a1, [h.op_change_trust(idr, INT64_MAX)]))
+        allow(b"IDR", 1, T.AllowTrustResultCode.ALLOW_TRUST_SUCCESS)
+        h.apply_tx(h.tx(gw, [h.op_payment(apub, 20000, asset=usd)]))
+        h.apply_tx(h.tx(gw, [h.op_payment(apub, 20000, asset=idr)]))
+        res, _ = h.apply_tx(h.tx(a1, [h.op_manage_sell_offer(
+            usd, idr, 1000, 1, 1)]))
+        assert res.result.result.type == T.TransactionResultCode.txSUCCESS
+        # leaf: authorize = AUTHORIZED | AUTHORIZED_TO_MAINTAIN (1|2)
+        meta = allow(b"IDR", 3,
+                     T.AllowTrustResultCode.ALLOW_TRUST_MALFORMED)
+        assert_section(
+            d, "authorized to maintain liabilities|protocol version 19|"
+               "allow trust|AUTHORIZED_FLAG and "
+               "AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG can't be used "
+               "together", [meta])
+
+
+class TestBeginSponsoringBaselines:
+    """sponsor future reserves|protocol version 19|...
+    (BeginSponsoringFutureReservesTests.cpp:76-190).  The recorded meta
+    per leaf is the fixture create; the begin/end sandwich itself is
+    raw-applied (uncommitted fee-less apply) and asserted as a
+    differential."""
+
+    def _fixture(self):
+        h = RefHarness()
+        a1 = SecretKey(named_account_seed("a1"))
+        _, meta = h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+            a1.public_key().raw, h.min_balance(0))]))
+        return h, a1, meta
+
+    def _raw_apply(self, h, env):
+        from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+        from stellar_core_tpu.transactions.frame import TransactionFrame
+
+        frame = TransactionFrame(h.app.config.network_id(), env)
+        with LedgerTxn(h.app.ledger_manager.root) as ltx:
+            assert frame.check_valid(ltx).ok
+            ok, result, _ = frame.apply(ltx)
+            ltx.rollback()
+        return ok, result
+
+    def test_success(self):
+        d = load_baseline("BeginSponsoringFutureReservesTests.json")
+        h, a1, meta = self._fixture()
+        apub = a1.public_key().raw
+        begin = h._op(T.OperationType.BEGIN_SPONSORING_FUTURE_RESERVES,
+                      T.BeginSponsoringFutureReservesOp.make(
+                          sponsoredID=T.account_id(apub)))
+        end = h._op(T.OperationType.END_SPONSORING_FUTURE_RESERVES,
+                    source=apub)
+        ok, result = self._raw_apply(
+            h, h.tx(h.root_sk, [begin, end], extra_signers=[a1]))
+        assert ok
+        assert_section(
+            d, "sponsor future reserves|protocol version 19|success",
+            [meta])
+
+    def test_bad_sponsorship(self):
+        d = load_baseline("BeginSponsoringFutureReservesTests.json")
+        h, a1, meta = self._fixture()
+        begin = h._op(T.OperationType.BEGIN_SPONSORING_FUTURE_RESERVES,
+                      T.BeginSponsoringFutureReservesOp.make(
+                          sponsoredID=T.account_id(a1.public_key().raw)))
+        ok, result = self._raw_apply(h, h.tx(h.root_sk, [begin]))
+        assert not ok
+        assert result.result.type == \
+            T.TransactionResultCode.txBAD_SPONSORSHIP
+        assert_section(
+            d, "sponsor future reserves|protocol version 19|bad sponsorship",
+            [meta])
+
+    def test_already_sponsored(self):
+        d = load_baseline("BeginSponsoringFutureReservesTests.json")
+        h, a1, meta = self._fixture()
+        apub = a1.public_key().raw
+        begin = h._op(T.OperationType.BEGIN_SPONSORING_FUTURE_RESERVES,
+                      T.BeginSponsoringFutureReservesOp.make(
+                          sponsoredID=T.account_id(apub)))
+        begin2 = h._op(T.OperationType.BEGIN_SPONSORING_FUTURE_RESERVES,
+                       T.BeginSponsoringFutureReservesOp.make(
+                           sponsoredID=T.account_id(apub)))
+        ok, result = self._raw_apply(h, h.tx(h.root_sk, [begin, begin2]))
+        assert not ok
+        assert result.result.type == T.TransactionResultCode.txFAILED
+        ops = result.result.value
+        BS = T.BeginSponsoringFutureReservesResultCode
+        assert ops[0].value.value.type == \
+            BS.BEGIN_SPONSORING_FUTURE_RESERVES_SUCCESS
+        assert ops[1].value.value.type == \
+            BS.BEGIN_SPONSORING_FUTURE_RESERVES_ALREADY_SPONSORED
+        assert_section(
+            d, "sponsor future reserves|protocol version 19|"
+               "already sponsored", [meta])
+
+
+class TestFeeBumpBaselines:
+    """fee bump transactions|protocol version 19|...
+    (FeeBumpTransactionTests.cpp:64-264).  Each leaf's recorded meta is
+    the fixture create; the fee-bump checkValid behaviors are asserted as
+    differentials against our FeeBumpTransactionFrame."""
+
+    def _fee_bump_env(self, h, fee_source, source, dest_pub, outer_fee,
+                      inner_fee, amount, outer_signers, seq=None):
+        """ref feeBumpUnsigned + sign()s, signer list explicit."""
+        inner_tx = T.Transaction.make(
+            sourceAccount=T.MuxedAccount.make(
+                T.CryptoKeyType.KEY_TYPE_ED25519,
+                source.public_key().raw),
+            fee=inner_fee,
+            seqNum=h._next_seq(source.public_key().raw)
+            if seq is None else seq,
+            cond=T.Preconditions.make(T.PreconditionType.PRECOND_NONE),
+            memo=T.Memo.make(T.MemoType.MEMO_NONE),
+            operations=[h.op_payment(dest_pub, amount)],
+            ext=T.Transaction.fields[6][1].make(0))
+        net = h.app.config.network_id()
+        inner_payload = T.TransactionSignaturePayload.make(
+            networkId=net,
+            taggedTransaction=T.TransactionSignaturePayload
+            .fields[1][1].make(T.EnvelopeType.ENVELOPE_TYPE_TX, inner_tx))
+        inner_sig = T.DecoratedSignature.make(
+            hint=source.public_key().raw[-4:],
+            signature=source.sign(sha256(
+                T.TransactionSignaturePayload.encode(inner_payload))))
+        fb = T.FeeBumpTransaction.make(
+            feeSource=T.MuxedAccount.make(
+                T.CryptoKeyType.KEY_TYPE_ED25519,
+                fee_source.public_key().raw),
+            fee=outer_fee,
+            innerTx=T.FeeBumpTransaction.fields[2][1].make(
+                T.EnvelopeType.ENVELOPE_TYPE_TX,
+                T.TransactionV1Envelope.make(
+                    tx=inner_tx, signatures=[inner_sig])),
+            ext=T.FeeBumpTransaction.fields[3][1].make(0))
+        outer_payload = T.TransactionSignaturePayload.make(
+            networkId=net,
+            taggedTransaction=T.TransactionSignaturePayload
+            .fields[1][1].make(
+                T.EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP, fb))
+        outer_hash = sha256(
+            T.TransactionSignaturePayload.encode(outer_payload))
+        sigs = [T.DecoratedSignature.make(
+            hint=sk.public_key().raw[-4:], signature=sk.sign(outer_hash))
+            for sk in outer_signers]
+        return T.TransactionEnvelope.make(
+            T.EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP,
+            T.FeeBumpTransactionEnvelope.make(tx=fb, signatures=sigs))
+
+    def _check(self, h, env):
+        from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+        from stellar_core_tpu.transactions.fee_bump import \
+            FeeBumpTransactionFrame
+
+        frame = FeeBumpTransactionFrame(h.app.config.network_id(), env)
+        with LedgerTxn(h.app.ledger_manager.root) as ltx:
+            res = frame.check_valid(ltx)
+            ltx.rollback()
+        return res
+
+    def test_fee_processing(self):
+        d = load_baseline("FeeBumpTransactionTests.json")
+        h = RefHarness()
+        acc = SecretKey(named_account_seed("A"))
+        _, meta = h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+            acc.public_key().raw, 2 * h.base_reserve + 2 * h.txfee)]))
+        assert_section(
+            d, "fee bump transactions|protocol version 19|fee processing",
+            [meta])
+        # differential: processFeeSeqNum charges the OUTER source 2*fee
+        from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+        from stellar_core_tpu.transactions.fee_bump import \
+            FeeBumpTransactionFrame
+
+        env = self._fee_bump_env(h, acc, h.root_sk,
+                                 h.root_sk.public_key().raw,
+                                 2 * h.txfee, h.txfee, 1, [acc])
+        frame = FeeBumpTransactionFrame(h.app.config.network_id(), env)
+        with LedgerTxn(h.app.ledger_manager.root) as ltx:
+            before = ltx.load_account(
+                acc.public_key().raw).data.value.balance
+            frame.process_fee_seq_num(ltx, base_fee=h.txfee)
+            after = ltx.load_account(
+                acc.public_key().raw).data.value.balance
+            ltx.rollback()
+        assert before == after + 2 * h.txfee
+
+    def test_validity_bad_signature_order(self):
+        """Outer signature taken over the envelope BEFORE the inner
+        signature was attached -> txBAD_AUTH (ref :139-155)."""
+        d = load_baseline("FeeBumpTransactionTests.json")
+        h = RefHarness()
+        acc = SecretKey(named_account_seed("A"))
+        _, meta = h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+            acc.public_key().raw, 2 * h.base_reserve)]))
+        assert_section(
+            d, "fee bump transactions|protocol version 19|validity|"
+               "bad signatures, signature invalid", [meta])
+        # build with wrong-order signing: outer signature over fb whose
+        # inner has NO signatures yet
+        net = h.app.config.network_id()
+        inner_tx = T.Transaction.make(
+            sourceAccount=T.MuxedAccount.make(
+                T.CryptoKeyType.KEY_TYPE_ED25519,
+                h.root_sk.public_key().raw),
+            fee=h.txfee, seqNum=h._next_seq(h.root_sk.public_key().raw),
+            cond=T.Preconditions.make(T.PreconditionType.PRECOND_NONE),
+            memo=T.Memo.make(T.MemoType.MEMO_NONE),
+            operations=[h.op_payment(h.root_sk.public_key().raw, 1)],
+            ext=T.Transaction.fields[6][1].make(0))
+        fb_unsigned = T.FeeBumpTransaction.make(
+            feeSource=T.MuxedAccount.make(
+                T.CryptoKeyType.KEY_TYPE_ED25519, acc.public_key().raw),
+            fee=2 * h.txfee,
+            innerTx=T.FeeBumpTransaction.fields[2][1].make(
+                T.EnvelopeType.ENVELOPE_TYPE_TX,
+                T.TransactionV1Envelope.make(tx=inner_tx, signatures=[])),
+            ext=T.FeeBumpTransaction.fields[3][1].make(0))
+        outer_payload = T.TransactionSignaturePayload.make(
+            networkId=net,
+            taggedTransaction=T.TransactionSignaturePayload
+            .fields[1][1].make(
+                T.EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP, fb_unsigned))
+        outer_sig = T.DecoratedSignature.make(
+            hint=acc.public_key().raw[-4:],
+            signature=acc.sign(sha256(
+                T.TransactionSignaturePayload.encode(outer_payload))))
+        # now sign the inner (mutating what the outer signature covered)
+        inner_payload = T.TransactionSignaturePayload.make(
+            networkId=net,
+            taggedTransaction=T.TransactionSignaturePayload
+            .fields[1][1].make(T.EnvelopeType.ENVELOPE_TYPE_TX, inner_tx))
+        inner_sig = T.DecoratedSignature.make(
+            hint=h.root_sk.public_key().raw[-4:],
+            signature=h.root_sk.sign(sha256(
+                T.TransactionSignaturePayload.encode(inner_payload))))
+        fb = fb_unsigned._replace(
+            innerTx=T.FeeBumpTransaction.fields[2][1].make(
+                T.EnvelopeType.ENVELOPE_TYPE_TX,
+                T.TransactionV1Envelope.make(
+                    tx=inner_tx, signatures=[inner_sig])))
+        env = T.TransactionEnvelope.make(
+            T.EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP,
+            T.FeeBumpTransactionEnvelope.make(tx=fb,
+                                              signatures=[outer_sig]))
+        res = self._check(h, env)
+        assert res.code == T.TransactionResultCode.txBAD_AUTH
+
+
+class TestManageBuyOfferBaselines:
+    """manage buy offer failure modes|protocol version 19|negative offerID
+    (ManageBuyOfferTests.cpp:1-30,340-353)."""
+
+    def test_negative_offer_id(self):
+        d = load_baseline("ManageBuyOfferTests.json")
+        h = RefHarness()
+        i1 = SecretKey(named_account_seed("issuer1"))
+        i2 = SecretKey(named_account_seed("issuer2"))
+        for sk in (i1, i2):
+            h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+                sk.public_key().raw, h.min_balance(0) + 100 * h.txfee)]))
+        cur1 = h.asset(i1.public_key().raw, b"CUR1")
+        op = h._op(T.OperationType.MANAGE_BUY_OFFER,
+                   T.ManageBuyOfferOp.make(
+                       selling=cur1, buying=h.native(), buyAmount=1,
+                       price=T.Price.make(n=1, d=1), offerID=-1))
+        res, meta = h.apply_tx(h.tx(i1, [op]))
+        opr = res.result.result.value[0]
+        assert opr.value.value.type == \
+            T.ManageBuyOfferResultCode.MANAGE_BUY_OFFER_MALFORMED
+        assert_section(
+            d, "manage buy offer failure modes|protocol version 19|"
+               "negative offerID", [meta])
+
+
+class TestClaimableBalanceBaselines:
+    """claimableBalance|protocol version 19|invalid asset
+    (ClaimableBalanceTests.cpp:900-951)."""
+
+    def test_invalid_asset(self):
+        d = load_baseline("ClaimableBalanceTests.json")
+        h = RefHarness()
+        acc1 = SecretKey(named_account_seed("acc1"))
+        acc2 = SecretKey(named_account_seed("acc2"))
+        issuer = SecretKey(named_account_seed("issuer"))
+        for sk in (acc1, acc2, issuer):
+            h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+                sk.public_key().raw, h.min_balance(3))]))
+        usd = h.asset(issuer.public_key().raw, b"USD")
+        h.apply_tx(h.tx(acc2, [h.op_change_trust(usd, INT64_MAX)]))
+
+        def simple_pred(levels):
+            if levels == 0:
+                return T.ClaimPredicate.make(
+                    T.ClaimPredicateType
+                    .CLAIM_PREDICATE_BEFORE_ABSOLUTE_TIME, INT64_MAX)
+            nxt = simple_pred(levels - 1)
+            return T.ClaimPredicate.make(
+                T.ClaimPredicateType.CLAIM_PREDICATE_OR, [nxt, nxt])
+
+        bad_usd = T.Asset.make(
+            T.AssetType.ASSET_TYPE_CREDIT_ALPHANUM4,
+            T.AlphaNum4.make(assetCode=b"\x00SD\x00",
+                             issuer=T.account_id(issuer.public_key().raw)))
+        claimant = T.Claimant.make(
+            T.ClaimantType.CLAIMANT_TYPE_V0,
+            T.Claimant.arms[T.ClaimantType.CLAIMANT_TYPE_V0][1].make(
+                destination=T.account_id(acc2.public_key().raw),
+                predicate=simple_pred(3)))
+        op = h._op(T.OperationType.CREATE_CLAIMABLE_BALANCE,
+                   T.CreateClaimableBalanceOp.make(
+                       asset=bad_usd, amount=100, claimants=[claimant]))
+        res, meta = h.apply_tx(h.tx(acc1, [op]))
+        opr = res.result.result.value[0]
+        assert opr.value.value.type == \
+            T.CreateClaimableBalanceResultCode.\
+            CREATE_CLAIMABLE_BALANCE_MALFORMED
+        assert_section(
+            d, "claimableBalance|protocol version 19|invalid asset", [meta])
+
+
+class TestRevokeSponsorshipBaselines:
+    """update sponsorship|protocol version 19|entry is not sponsored|
+    account is not sponsored|account (RevokeSponsorshipTests.cpp:53-74)."""
+
+    def test_account_not_sponsored(self):
+        d = load_baseline("RevokeSponsorshipTests.json")
+        h = RefHarness()
+        a1 = SecretKey(named_account_seed("a1"))
+        apub = a1.public_key().raw
+        _, meta = h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+            apub, h.min_balance(1))]))
+        assert_section(
+            d, "update sponsorship|protocol version 19|"
+               "entry is not sponsored|account is not sponsored|account",
+            [meta])
+        # differential: revoking the (non-)sponsorship of one's own
+        # account entry is a success no-op
+        from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+        from stellar_core_tpu.transactions.frame import TransactionFrame
+
+        key = T.LedgerKey.make(
+            T.LedgerEntryType.ACCOUNT,
+            T.LedgerKey.arms[T.LedgerEntryType.ACCOUNT][1].make(
+                accountID=T.account_id(apub)))
+        op = h._op(T.OperationType.REVOKE_SPONSORSHIP,
+                   T.RevokeSponsorshipOp.make(
+                       T.RevokeSponsorshipType
+                       .REVOKE_SPONSORSHIP_LEDGER_ENTRY, key))
+        frame = TransactionFrame(h.app.config.network_id(),
+                                 h.tx(a1, [op]))
+        with LedgerTxn(h.app.ledger_manager.root) as ltx:
+            assert frame.check_valid(ltx).ok
+            ok, result, _ = frame.apply(ltx)
+            ltx.rollback()
+        assert ok, result
+
+
+class TestOfferBaselines:
+    """create offer|protocol version 19|create offer errors|create offer
+    without account (OfferTests.cpp:95-141): a manage-offer tx from a
+    NONEXISTENT account fails txNO_ACCOUNT; the reference records its
+    (empty-changes) meta via applyCheck with fee processing skipped."""
+
+    def test_create_offer_without_account(self):
+        d = load_baseline("OfferTests.json")
+        h = RefHarness()
+        issuer = SecretKey(named_account_seed("issuer"))
+        min_balance2 = h.min_balance(2) + 20 * h.txfee
+        h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+            issuer.public_key().raw, min_balance2 * 10)]))
+        idr = h.asset(issuer.public_key().raw, b"IDR")
+        usd = h.asset(issuer.public_key().raw, b"USD")
+        a1 = SecretKey(named_account_seed("a1"))  # never created
+        env = h.tx(a1, [h.op_manage_sell_offer(idr, usd, 100, 1, 1)],
+                   seq=1)
+        # mirror applyCheck's txNO_ACCOUNT branch: empty close to advance
+        # the ledger, then apply WITHOUT fee processing, committed
+        from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+        from stellar_core_tpu.transactions.frame import TransactionFrame
+
+        h.close_empty()
+        frame = TransactionFrame(h.app.config.network_id(), env)
+        with LedgerTxn(h.app.ledger_manager.root) as ltx:
+            ok, result, meta = frame.apply(ltx)
+            ltx.commit()
+        assert not ok
+        assert result.result.type == T.TransactionResultCode.txNO_ACCOUNT
+        assert_section(
+            d, "create offer|protocol version 19|create offer errors|"
+               "create offer without account", [meta])
+
+
+class TestClawbackClaimableBalanceBaselines:
+    """clawbackClaimableBalance|protocol version 19|basic test
+    (ClawbackClaimableBalanceTests.cpp:1-71)."""
+
+    def test_basic(self):
+        d = load_baseline("ClawbackClaimableBalanceTests.json")
+        h = RefHarness()
+        a1 = SecretKey(named_account_seed("A1"))
+        gw = SecretKey(named_account_seed("gw"))
+        apub, gpub = a1.public_key().raw, gw.public_key().raw
+        for sk in (a1, gw):
+            h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+                sk.public_key().raw, h.min_balance(4))]))
+        idr = h.asset(gpub, b"IDR")
+        # v17+ setup (parent key): clawback-enabled + revocable, trust, pay
+        h.apply_tx(h.tx(gw, [h.op_set_options(set_flags=0x8 | 0x2)]))
+        h.apply_tx(h.tx(a1, [h.op_change_trust(idr, 1000)]))
+        h.apply_tx(h.tx(gw, [h.op_payment(apub, 100, asset=idr)]))
+        metas = []
+        claimant = T.Claimant.make(
+            T.ClaimantType.CLAIMANT_TYPE_V0,
+            T.Claimant.arms[T.ClaimantType.CLAIMANT_TYPE_V0][1].make(
+                destination=T.account_id(gpub),
+                predicate=T.ClaimPredicate.make(
+                    T.ClaimPredicateType.CLAIM_PREDICATE_UNCONDITIONAL)))
+        res, m1 = h.apply_tx(h.tx(a1, [h._op(
+            T.OperationType.CREATE_CLAIMABLE_BALANCE,
+            T.CreateClaimableBalanceOp.make(
+                asset=idr, amount=99, claimants=[claimant]))]))
+        opr = res.result.result.value[0]
+        assert opr.value.value.type == \
+            T.CreateClaimableBalanceResultCode.\
+            CREATE_CLAIMABLE_BALANCE_SUCCESS
+        balance_id = opr.value.value.value
+        metas.append(m1)
+        CB = T.ClawbackClaimableBalanceResultCode
+        res, m2 = h.apply_tx(h.tx(gw, [h._op(
+            T.OperationType.CLAWBACK_CLAIMABLE_BALANCE,
+            T.ClawbackClaimableBalanceOp.make(balanceID=balance_id))]))
+        assert res.result.result.value[0].value.value.type == \
+            CB.CLAWBACK_CLAIMABLE_BALANCE_SUCCESS
+        metas.append(m2)
+        res, m3 = h.apply_tx(h.tx(gw, [h._op(
+            T.OperationType.CLAIM_CLAIMABLE_BALANCE,
+            T.ClaimClaimableBalanceOp.make(balanceID=balance_id))]))
+        assert res.result.result.value[0].value.value.type == \
+            T.ClaimClaimableBalanceResultCode.\
+            CLAIM_CLAIMABLE_BALANCE_DOES_NOT_EXIST
+        metas.append(m3)
+        res, m4 = h.apply_tx(h.tx(gw, [h._op(
+            T.OperationType.CLAWBACK_CLAIMABLE_BALANCE,
+            T.ClawbackClaimableBalanceOp.make(balanceID=balance_id))]))
+        assert res.result.result.value[0].value.value.type == \
+            CB.CLAWBACK_CLAIMABLE_BALANCE_DOES_NOT_EXIST
+        metas.append(m4)
+        assert_section(
+            d, "clawbackClaimableBalance|protocol version 19|basic test",
+            metas)

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Bucket scale evidence (VERDICT r4 task 5 'done when'): a synthetic
+1M-entry ledger flows through the disk-tier BucketList and back out of a
+catchup-style streaming read with bounded RSS.  Writes
+BUCKET_SCALE_r05.json.
+
+Usage: python tools/bucket_scale_bench.py [n_entries] [per_close]
+"""
+import json
+import os
+import resource
+import statistics
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def rss_mb():
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+
+
+def main():
+    n_entries = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    per_close = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
+
+    from stellar_core_tpu.bucket.bucket_list import BucketList
+    from stellar_core_tpu.bucket.disk_bucket import DiskBucket
+    from stellar_core_tpu.ledger.ledger_txn import entry_to_key, key_bytes
+    from stellar_core_tpu.transactions import utils as U
+
+    tmp = tempfile.mkdtemp(prefix="bucket-scale-")
+    bl = BucketList(disk_dir=tmp, disk_level=2)
+    rss_start = rss_mb()
+    t_start = time.time()
+    close_times = []
+    seq = 1
+    made = 0
+    while made < n_entries:
+        seq += 1
+        changes = []
+        for j in range(min(per_close, n_entries - made)):
+            i = made + j
+            e = U.make_account_entry(
+                i.to_bytes(4, "big") * 8, 10_000_000 + i)
+            changes.append((key_bytes(entry_to_key(e)), e, False))
+        made += len(changes)
+        t0 = time.perf_counter()
+        bl.add_batch(seq, changes)
+        close_times.append(time.perf_counter() - t0)
+        if seq % 50 == 0:
+            print(f"seq {seq}: {made} entries, rss {rss_mb():.0f}MB",
+                  flush=True)
+    build_s = time.time() - t_start
+    rss_after_build = rss_mb()
+
+    # catchup-style streaming read of the full live set
+    t0 = time.time()
+    count = 0
+    for _ in bl.iter_live_entries():
+        count += 1
+    stream_s = time.time() - t0
+    rss_after_stream = rss_mb()
+    assert count == n_entries, (count, n_entries)
+
+    disk_files = [f for f in os.listdir(tmp) if f.startswith("bucket-")]
+    disk_bytes = sum(
+        os.path.getsize(os.path.join(tmp, f)) for f in disk_files)
+    disk_levels = sum(
+        1 for lv in bl.levels for b in (lv.curr, lv.snap)
+        if isinstance(b, DiskBucket) and not b.is_empty())
+
+    out = {
+        "n_entries": n_entries,
+        "per_close": per_close,
+        "closes": seq - 1,
+        "build_seconds": round(build_s, 1),
+        "close_ms_p50": round(
+            statistics.median(close_times) * 1000, 1),
+        "close_ms_max": round(max(close_times) * 1000, 1),
+        "stream_read_seconds": round(stream_s, 1),
+        "streamed_entries": count,
+        "rss_mb_start": round(rss_start, 1),
+        "rss_mb_after_build": round(rss_after_build, 1),
+        "rss_mb_after_stream": round(rss_after_stream, 1),
+        "disk_bucket_files": len(disk_files),
+        "disk_bucket_bytes": disk_bytes,
+        "disk_backed_buckets_live": disk_levels,
+        "bucket_hash": bl.hash().hex(),
+    }
+    with open(os.path.join(REPO, "BUCKET_SCALE_r05.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    import shutil
+
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Bench-shaped multi-chip evidence (VERDICT r4 task 4): run the FULL
+100k-signature sharded admission step + a 64-validator parallel ballot
+tally on an 8-device mesh and record per-device throughput in
+MULTICHIP_BENCH_r05.json.
+
+On this machine the mesh is 8 virtual host-CPU devices (the TPU tunnel
+exposes one chip at most), so the recorded rate is the host-CPU XLA rate
+with an honest "platform: cpu" label — the artifact proves the sharded
+program at bench shapes (100k sigs, real shardings, real collectives),
+which is what the virtual mesh CAN prove.  Run on a real v5e-8 the same
+file captures real scaling.
+
+Usage: python tools/multichip_bench.py [n_devices] [n_sigs]
+"""
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    n_devices = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    n_sigs = int(sys.argv[2]) if len(sys.argv) > 2 else 100_000
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from stellar_core_tpu.models.admission import bench_sharded
+
+    npz = os.path.join(REPO, "tools", "capture_workload.npz")
+    result = bench_sharded(
+        n_devices, n_sigs=n_sigs,
+        workload_npz=npz if os.path.exists(npz) else None)
+    # 1-device comparison at a smaller batch (same program, no sharding)
+    small = max(1024, n_sigs // 50)
+    result["one_device_comparison"] = bench_sharded(
+        1, n_sigs=small,
+        workload_npz=npz if os.path.exists(npz) else None)
+    result["note"] = (
+        "virtual host-CPU mesh: all devices share one host's cores, so "
+        "per-device rate is a program-shape artifact, not chip scaling; "
+        "the XLA-on-CPU ed25519 rate is far below both libsodium and the "
+        "TPU path by design (see BENCH_*.json for the device numbers)")
+    out = os.path.join(REPO, "MULTICHIP_BENCH_r05.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
