@@ -57,7 +57,7 @@ def _load_best():
 
 def main() -> None:
     n_sigs = int(os.environ.get("BENCH_N", "100000"))
-    n_closes = int(os.environ.get("BENCH_CLOSES", "8"))
+    n_closes = int(os.environ.get("BENCH_CLOSES", "24"))
     close_txs = int(os.environ.get("BENCH_CLOSE_TXS", "1000"))
     probe_budget = float(os.environ.get("BENCH_PROBE_BUDGET", "420"))
     device_budget = float(os.environ.get("BENCH_DEVICE_BUDGET", "1500"))
@@ -90,7 +90,8 @@ def main() -> None:
     # path on the first close, exactly like the reference's load tests
     app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), test_config(
         UPGRADE_DESIRED_MAX_TX_SET_SIZE=max(100, close_txs),
-        CRYPTO_BACKEND="cpu"))
+        CRYPTO_BACKEND="cpu",
+        DEFERRED_GC=True))  # the production close-latency GC policy
     app.start()
     app.herder.manual_close()  # applies the max-tx-set-size upgrade
     assert app.ledger_manager.last_closed_header().maxTxSetSize >= close_txs
@@ -130,22 +131,46 @@ def main() -> None:
     # envelopes would be rejected as sequence gaps
     lg2 = LoadGenerator(app)
     lg2.create_accounts(max(close_txs, 1), prefix=b"close-bench")
-    close_times = []
-    for _ in range(n_closes):
-        admitted = sum(
-            1 for env in lg2.generate_payments(close_txs)
-            if app.herder.recv_transaction(env) == 0)
-        assert admitted == close_txs, \
-            f"only {admitted}/{close_txs} txs admitted"
-        t0 = time.perf_counter()
-        app.herder.manual_close()
-        close_times.append((time.perf_counter() - t0) * 1000)
-        # the upgraded maxTxSetSize must have let the WHOLE batch close —
-        # a trimmed set would silently measure a smaller close
-        assert app.herder.tx_queue.size() == 0, "close left txs queued"
+    # MIXED shape: payments + DEX offers (close numbers must not be
+    # payments-only; ref LoadGenMode::MIXED_TXS)
+    lg2.setup_dex()
+    dex_pct = int(os.environ.get("BENCH_DEX_PCT", "30"))
+
+    def run_closes(shape):
+        times = []
+        for _ in range(n_closes):
+            if shape == "mixed":
+                envs = lg2.generate_mixed(close_txs, dex_percent=dex_pct)
+            else:
+                envs = lg2.generate_payments(close_txs)
+            admitted = sum(1 for env in envs
+                           if app.herder.recv_transaction(env) == 0)
+            assert admitted == close_txs, \
+                f"only {admitted}/{close_txs} txs admitted"
+            t0 = time.perf_counter()
+            app.herder.manual_close()
+            times.append((time.perf_counter() - t0) * 1000)
+            # the upgraded maxTxSetSize must have let the WHOLE batch
+            # close — a trimmed set would silently measure less
+            assert app.herder.tx_queue.size() == 0, "close left txs"
+        return times
+
+    pay_times = run_closes("pay")
+    close_times = run_closes("mixed")
+    pay_p50 = statistics.median(pay_times) if pay_times else None
     close_p50 = statistics.median(close_times) if close_times else None
+    import math
+
+    close_p99 = (sorted(close_times)[
+        max(0, math.ceil(len(close_times) * 0.99) - 1)]
+        if close_times else None)
+    close_max = max(close_times) if close_times else None
     if close_p50 is not None:
-        _note(f"close p50: {close_p50:.1f} ms at {close_txs} txs")
+        _note(f"close p50: {close_p50:.1f} ms  p99: {close_p99:.1f} ms  "
+              f"max: {close_max:.1f} ms at {close_txs} txs over "
+              f"{len(close_times)} closes (crossing level-0/1 spill "
+              "boundaries; FutureBucket staging + deferred GC keep "
+              "p99 near p50)")
 
     # --- device stage (subprocess owns the TPU) ---
     device_result = None
@@ -220,7 +245,15 @@ def main() -> None:
         "device": device_label,
         "ledger_close_p50_ms": (round(close_p50, 1)
                                 if close_p50 is not None else None),
+        "ledger_close_p99_ms": (round(close_p99, 1)
+                                if close_p99 is not None else None),
+        "ledger_close_max_ms": (round(close_max, 1)
+                                if close_max is not None else None),
+        "close_samples": len(close_times),
         "close_txs": close_txs,
+        "close_shape": f"mixed({dex_pct}% dex)",
+        "ledger_close_p50_ms_payments": (round(pay_p50, 1)
+                                         if pay_p50 is not None else None),
     }
     if best is not None:
         line["best_device_capture"] = best

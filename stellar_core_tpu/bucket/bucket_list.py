@@ -339,7 +339,7 @@ class BucketList:
                 # would be discarded by the identity check; don't stage
                 # doomed work
                 nxt_spill = ledger_seq + level_half(level)
-                if nxt_spill % level_half(level + 1) == 0:
+                if level_should_spill(nxt_spill, level + 1):
                     continue
                 snap = self.levels[level].snap
                 curr = self.levels[level + 1].curr

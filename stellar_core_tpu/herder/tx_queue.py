@@ -81,6 +81,11 @@ class TransactionQueue:
             ltx.rollback()
         if not res.ok:
             return self.ADD_STATUS_ERROR
+        # stamp the verdict: TxSetFrame.make_from_transactions skips a
+        # full re-check for frames validated against this same LCL (the
+        # reference pays the re-check in C++; here it would dominate the
+        # close trigger)
+        frame.checked_valid_lcl = lm.last_closed_seq()
 
         # global capacity: evict the cheapest tails, or reject the
         # newcomer if IT is the cheapest (ref TxQueueLimiter::canAddTx)

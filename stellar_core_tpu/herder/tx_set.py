@@ -48,6 +48,7 @@ class TxSetFrame:
             by_source.setdefault(f.source_account_id(), []).append(f)
         valid: List[TransactionFrame] = []
         with LedgerTxn(ltx_root) as ltx:
+            lcl_seq = ltx.header().ledgerSeq
             for source, fs in by_source.items():
                 fs.sort(key=lambda f: f.seq_num())
                 entry = ltx.load_account(source)
@@ -55,9 +56,13 @@ class TxSetFrame:
                 for f in fs:
                     if seq is None or f.seq_num() != seq + 1:
                         break
-                    res = f.check_valid(ltx, current_seq=seq)
-                    if not res.ok:
-                        break
+                    # skip the full re-check for frames the queue already
+                    # validated against this very LCL (admission stamps
+                    # checked_valid_lcl); state can't have moved since
+                    if getattr(f, "checked_valid_lcl", None) != lcl_seq:
+                        res = f.check_valid(ltx, current_seq=seq)
+                        if not res.ok:
+                            break
                     valid.append(f)
                     seq = f.seq_num()
             ltx.rollback()

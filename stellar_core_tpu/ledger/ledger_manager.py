@@ -237,6 +237,17 @@ class LedgerManager:
         # meta stream for downstream consumers
         self.app.emit_ledger_close_meta(
             new_header, tx_set, tx_result_metas, upgrade_metas)
+        self._post_close_gc(new_header.ledgerSeq)
+
+    def _post_close_gc(self, seq: int) -> None:
+        """DEFERRED_GC: young-gen collection after every close, full
+        collection every 64 (the checkpoint cadence) — never during the
+        close itself."""
+        if not self.app.config.DEFERRED_GC:
+            return
+        import gc
+
+        gc.collect(2 if seq % 64 == 0 else 1)
 
     def _store_bucket_state(self) -> None:
         """Persist the bucket-list level hashes so a restarted node can
@@ -315,11 +326,11 @@ class LedgerManager:
 
     def _store_tx_history(self, seq: int, frames, metas) -> None:
         cur = self.app.database.cursor()
-        for i, (frame, meta) in enumerate(zip(frames, metas)):
-            cur.execute(
-                "INSERT INTO txhistory(txid, ledgerseq, txindex, txbody, "
-                "txresult, txmeta) VALUES(?,?,?,?,?,?)",
-                (frame.full_hash(), seq, i,
-                 T.TransactionEnvelope.encode(frame.envelope),
-                 T.TransactionResultPair.encode(meta.result),
-                 T.TransactionMeta.encode(meta.txApplyProcessing)))
+        cur.executemany(
+            "INSERT INTO txhistory(txid, ledgerseq, txindex, txbody, "
+            "txresult, txmeta) VALUES(?,?,?,?,?,?)",
+            [(frame.full_hash(), seq, i,
+              T.TransactionEnvelope.encode(frame.envelope),
+              T.TransactionResultPair.encode(meta.result),
+              T.TransactionMeta.encode(meta.txApplyProcessing))
+             for i, (frame, meta) in enumerate(zip(frames, metas))])

@@ -150,6 +150,7 @@ class LedgerTxn(AbstractLedgerTxn):
             parent._child = self
         self._delta: Dict[bytes, Optional[object]] = {}
         self._vkeys: set = set()  # virtual (\xff) keys present in _delta
+        self._okeys: set = set()  # offer keys present in _delta
         self._header = None  # modified header, if any
         self._child: Optional["LedgerTxn"] = None
         self._open = True
@@ -191,7 +192,10 @@ class LedgerTxn(AbstractLedgerTxn):
         self._check_open()
         entry = entry._replace(
             lastModifiedLedgerSeq=self.header().ledgerSeq)
-        self._delta[key_bytes(entry_to_key(entry))] = entry
+        kb = key_bytes(entry_to_key(entry))
+        self._delta[kb] = entry
+        if kb.startswith(_OFFER_PREFIX):
+            self._okeys.add(kb)
 
     def erase(self, key) -> None:
         self._check_open()
@@ -199,6 +203,8 @@ class LedgerTxn(AbstractLedgerTxn):
         if self.get(kb) is None:
             raise LedgerTxnError("erasing nonexistent entry")
         self._delta[kb] = None
+        if kb.startswith(_OFFER_PREFIX):
+            self._okeys.add(kb)
 
     # -- virtual entries (sponsorship bookkeeping; see module header) -------
 
@@ -239,6 +245,7 @@ class LedgerTxn(AbstractLedgerTxn):
         else:
             self.parent._delta.update(self._delta)
             self.parent._vkeys |= self._vkeys
+            self.parent._okeys |= self._okeys
             if self._header is not None:
                 self.parent._header = self._header
         self._close()
@@ -312,9 +319,19 @@ class LedgerTxn(AbstractLedgerTxn):
 
     def _collect_overrides(self, prefix: bytes):
         """Uncommitted delta entries (and deletions) with the given key
-        prefix up the layer chain, nearest layer winning, plus the root."""
+        prefix up the layer chain, nearest layer winning, plus the root.
+        Offer keys ride the per-layer ``_okeys`` index — the unindexed
+        scan was O(total delta) per best_offer call, quadratic over a
+        DEX-heavy close."""
         overrides: Dict[bytes, Optional[object]] = {}
         layer = self
+        if prefix == _OFFER_PREFIX:
+            while isinstance(layer, LedgerTxn):
+                for kb in layer._okeys:
+                    if kb not in overrides:
+                        overrides[kb] = layer._delta[kb]
+                layer = layer.parent
+            return overrides, layer
         while isinstance(layer, LedgerTxn):
             for kb, e in layer._delta.items():
                 if kb not in overrides and kb.startswith(prefix):

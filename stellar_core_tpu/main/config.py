@@ -154,6 +154,9 @@ class Config:
         # memory (ref BucketListDB; levels 0-3 hold <= 4^4 ledgers of
         # deltas and stay hot)
         self.DISK_BUCKET_LEVEL: int = kw.get("DISK_BUCKET_LEVEL", 4)
+        # run GC between closes instead of wherever allocation counters
+        # trip (a mid-close gen2 cycle costs >1s at 1000-tx closes)
+        self.DEFERRED_GC: bool = kw.get("DEFERRED_GC", True)
 
         # invariants
         self.INVARIANT_CHECKS: List[str] = kw.get("INVARIANT_CHECKS", [])
@@ -338,6 +341,10 @@ def test_config(n: int = 0, **kw) -> Config:
         # test quorums (2-of-3 etc.) are below the byzantine-safety bar
         # on purpose (ref getTestConfig setting UNSAFE_QUORUM)
         UNSAFE_QUORUM=True,
+        # suites/simulations keep normal GC: the deferred policy is
+        # process-global and one multi-app pytest process must not have
+        # collection disabled by the first test app
+        DEFERRED_GC=False,
         # tests pin the host tiers: "auto" would spawn one device-probe
         # subprocess per process, and the suite runs on CPU anyway;
         # device-path tests opt in explicitly

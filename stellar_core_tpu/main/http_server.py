@@ -35,6 +35,7 @@ class CommandHandler:
             "getsurveyresult": self.get_survey_result,
             "bans": self.bans,
             "unban": self.unban,
+            "generateload": self.generateload,
         }
 
     def handle(self, path: str, params: Dict[str, str]) -> tuple:
@@ -125,6 +126,78 @@ class CommandHandler:
             return 400, {"error": "manual close not enabled"}
         seq = self.app.herder.manual_close()
         return 200, {"ledger": seq}
+
+    def generateload(self, params):
+        """generateload?mode=create|pay|pretend|mixed&accounts=N&txs=N
+        [&dexpct=N&opcount=N] — drives the LoadGenerator through the
+        real tx queue (ref CommandHandler.cpp:125; the reference
+        registers this only in test builds, here it requires the
+        standalone/testing accelerators to be on)."""
+        cfg = self.app.config
+        if not (cfg.RUN_STANDALONE
+                or cfg.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING):
+            return 400, {"error": "generateload requires standalone/"
+                                  "testing mode"}
+        from ..simulation.load_generator import LoadGenerator
+
+        lg = getattr(self.app, "_load_generator", None)
+        if lg is None:
+            lg = self.app._load_generator = LoadGenerator(self.app)
+        mode = params.get("mode", "pay")
+        n_accounts = int(params.get("accounts", "100"))
+        n_txs = int(params.get("txs", "100"))
+
+        def submit(envs, note=None, on_all_pending=None):
+            statuses: dict = {}
+            for env in envs:
+                r = self.app.herder.recv_transaction(env)
+                statuses[r] = statuses.get(r, 0) + 1
+            if statuses == {0: len(envs)} and on_all_pending:
+                on_all_pending()
+            body = {"mode": mode, "submitted": len(envs),
+                    "status_counts": statuses}
+            if note:
+                body["note"] = note
+            return 200, body
+
+        # all seeding is TRANSACTION-based so the bucket-list commitment
+        # stays consistent with the SQL tier (self-check-clean); each
+        # seeding stage needs a ledger close before the next call
+        if mode == "create":
+            return submit(lg.create_account_envelopes(n_accounts),
+                          "accounts exist after the next close")
+        if not lg.accounts:
+            return 400, {"error": "run mode=create (and close) first"}
+        if mode == "pay":
+            envs = lg.generate_payments(n_txs)
+        elif mode == "pretend":
+            envs = lg.generate_pretend(
+                n_txs, op_count=int(params.get("opcount", "1")))
+        elif mode == "mixed":
+            # stages advance ONLY when every stage tx was admitted, so a
+            # mis-sequenced call (e.g. before the seeding close) can be
+            # retried instead of wedging the DEX setup
+            stage = getattr(lg, "_dex_stage", 0)
+            if stage == 0:
+                return submit(lg.create_dex_issuer_envelope(),
+                              "dex issuer submitted; close a ledger "
+                              "and call mode=mixed again",
+                              lambda: setattr(lg, "_dex_stage", 1))
+            if stage == 1:
+                return submit(lg.setup_dex_envelopes(),
+                              "dex trustlines submitted; close a "
+                              "ledger and call mode=mixed again",
+                              lambda: setattr(lg, "_dex_stage", 2))
+            if stage == 2:
+                return submit(lg.fund_dex_envelopes(),
+                              "dex funding submitted; close a ledger "
+                              "and call mode=mixed again",
+                              lambda: setattr(lg, "_dex_stage", 3))
+            envs = lg.generate_mixed(
+                n_txs, dex_percent=int(params.get("dexpct", "50")))
+        else:
+            return 400, {"error": f"unknown mode {mode!r}"}
+        return submit(envs)
 
     def survey_topology(self, params):
         """surveytopology?node=<hex-or-strkey> (ref CommandHandler

@@ -59,31 +59,14 @@ class LoadGenerator:
 
     def payment_envelope(self, src: SecretKey, dest: bytes, amount: int,
                          fee: int = 100):
-        tx = T.Transaction.make(
-            sourceAccount=T.muxed_account(src.public_key().raw),
-            fee=fee,
-            seqNum=self._next_seq(src),
-            cond=T.Preconditions.make(T.PreconditionType.PRECOND_NONE),
-            memo=T.MEMO_NONE_VALUE,
-            operations=[T.Operation.make(
-                sourceAccount=None,
-                body=T.OperationBody.make(
-                    T.OperationType.PAYMENT,
-                    T.PaymentOp.make(destination=T.muxed_account(dest),
-                                     asset=U.asset_native(),
-                                     amount=amount)))],
-            ext=T.Transaction.fields[6][1].make(0))
-        payload = T.TransactionSignaturePayload.make(
-            networkId=self.network_id,
-            taggedTransaction=T.TransactionSignaturePayload.fields[1][1]
-            .make(T.EnvelopeType.ENVELOPE_TYPE_TX, tx))
-        h = sha256(T.TransactionSignaturePayload.encode(payload))
-        sig = T.DecoratedSignature.make(
-            hint=signature_hint(src.public_key().raw),
-            signature=src.sign(h))
-        return T.TransactionEnvelope.make(
-            T.EnvelopeType.ENVELOPE_TYPE_TX,
-            T.TransactionV1Envelope.make(tx=tx, signatures=[sig]))
+        op = T.Operation.make(
+            sourceAccount=None,
+            body=T.OperationBody.make(
+                T.OperationType.PAYMENT,
+                T.PaymentOp.make(destination=T.muxed_account(dest),
+                                 asset=U.asset_native(),
+                                 amount=amount)))
+        return self._sign_tx(src, [op], fee)
 
     def generate_payments(self, n: int,
                           accounts: Optional[List[SecretKey]] = None
@@ -99,3 +82,226 @@ class LoadGenerator:
             dest = accts[(i + 1) % k].public_key().raw
             out.append(self.payment_envelope(src, dest, 1 + (i % 1000)))
         return out
+
+    # -- PRETEND mode -------------------------------------------------------
+
+    def pretend_envelope(self, src: SecretKey, op_count: int = 1,
+                         fee: int = 100):
+        """SetOptions no-op-shaped txs sized like real traffic (ref
+        LoadGenerator::pretendTransaction :721 — inflationDest=self,
+        16-char homeDomain, first op padded with an extra signer)."""
+        pub = src.public_key().raw
+        ops = []
+        for i in range(op_count):
+            home = b"*" * (24 if i == 0 else 16)
+            signer = None
+            if i == 0:
+                signer = T.Signer.make(
+                    key=T.SignerKey.make(
+                        T.SignerKeyType.SIGNER_KEY_TYPE_ED25519,
+                        bytes(32)),
+                    weight=0)  # weight 0 = delete-if-present no-op
+            ops.append(T.Operation.make(
+                sourceAccount=None,
+                body=T.OperationBody.make(
+                    T.OperationType.SET_OPTIONS,
+                    T.SetOptionsOp.make(
+                        inflationDest=T.account_id(pub),
+                        clearFlags=None, setFlags=None,
+                        masterWeight=None, lowThreshold=None,
+                        medThreshold=None, highThreshold=None,
+                        homeDomain=home, signer=signer))))
+        return self._sign_tx(src, ops, fee * max(1, op_count))
+
+    def generate_pretend(self, n: int, op_count: int = 1,
+                         accounts: Optional[List[SecretKey]] = None
+                         ) -> List:
+        accts = accounts or self.accounts
+        assert accts, "CREATE accounts first"
+        return [self.pretend_envelope(accts[i % len(accts)], op_count)
+                for i in range(n)]
+
+    # -- MIXED_TXS mode -----------------------------------------------------
+
+    def setup_dex(self, accounts: Optional[List[SecretKey]] = None,
+                  credit: int = 10**7) -> None:
+        """Seed the DEX leg of MIXED_TXS: a LOAD-asset issuer plus a
+        funded trustline for every generator account (bulk-written like
+        create_accounts; the per-tx path would be changeTrust+payment)."""
+        accts = accounts or self.accounts
+        assert accts, "CREATE accounts first"
+        root = self.app.ledger_manager.root
+        issuer = SecretKey(sha256(b"loadgen-dex-issuer"))
+        self.dex_issuer = issuer
+        self.dex_asset = U.make_asset(b"LOAD", issuer.public_key().raw)
+        with LedgerTxn(root) as ltx:
+            if ltx.load_account(issuer.public_key().raw) is None:
+                ltx.put(U.make_account_entry(
+                    issuer.public_key().raw, 10**9, seq_num=0))
+            for sk in accts:
+                pub = sk.public_key().raw
+                if ltx.load_trustline(pub, self.dex_asset) is None:
+                    ltx.put(U.make_trustline_entry(
+                        pub, self.dex_asset, balance=credit,
+                        limit=U.INT64_MAX))
+                    e = ltx.load_account(pub)
+                    acc = e.data.value
+                    ltx.put(e._replace(data=T.LedgerEntryData.make(
+                        T.LedgerEntryType.ACCOUNT,
+                        acc._replace(
+                            numSubEntries=acc.numSubEntries + 1))))
+            ltx.commit()
+
+    def offer_envelope(self, src: SecretKey, amount: int,
+                       price_n: int, price_d: int, fee: int = 100):
+        """Sell native for the LOAD asset (ref manageOfferTransaction —
+        every generated offer is new, offerID=0)."""
+        op = T.Operation.make(
+            sourceAccount=None,
+            body=T.OperationBody.make(
+                T.OperationType.MANAGE_SELL_OFFER,
+                T.ManageSellOfferOp.make(
+                    selling=U.asset_native(), buying=self.dex_asset,
+                    amount=amount,
+                    price=T.Price.make(n=price_n, d=price_d),
+                    offerID=0)))
+        return self._sign_tx(src, [op], fee)
+
+    def generate_mixed(self, n: int, dex_percent: int = 50,
+                       accounts: Optional[List[SecretKey]] = None
+                       ) -> List:
+        """Payments + DEX offers at ``dex_percent`` (ref MIXED_TXS
+        :308-318; deterministic pseudo-mix instead of the reference's
+        PRNG so benches are reproducible)."""
+        accts = accounts or self.accounts
+        assert accts, "CREATE accounts first"
+        assert getattr(self, "dex_asset", None) is not None, \
+            "setup_dex() first"
+        out = []
+        k = len(accts)
+        for i in range(n):
+            src = accts[i % k]
+            if (i * 7919 + 13) % 100 < dex_percent:
+                # prices spread so offers rarely cross (book grows like
+                # the reference's synthetic DEX load)
+                out.append(self.offer_envelope(
+                    src, 10 + i % 90, 100 + (i % 50), 100))
+            else:
+                dest = accts[(i + 1) % k].public_key().raw
+                out.append(self.payment_envelope(src, dest,
+                                                 1 + (i % 1000)))
+        return out
+
+    # -- shared signing -----------------------------------------------------
+
+    def _sign_tx(self, src: SecretKey, ops, fee: int):
+        tx = T.Transaction.make(
+            sourceAccount=T.muxed_account(src.public_key().raw),
+            fee=fee,
+            seqNum=self._next_seq(src),
+            cond=T.Preconditions.make(T.PreconditionType.PRECOND_NONE),
+            memo=T.MEMO_NONE_VALUE,
+            operations=ops,
+            ext=T.Transaction.fields[6][1].make(0))
+        payload = T.TransactionSignaturePayload.make(
+            networkId=self.network_id,
+            taggedTransaction=T.TransactionSignaturePayload.fields[1][1]
+            .make(T.EnvelopeType.ENVELOPE_TYPE_TX, tx))
+        h = sha256(T.TransactionSignaturePayload.encode(payload))
+        sig = T.DecoratedSignature.make(
+            hint=signature_hint(src.public_key().raw),
+            signature=src.sign(h))
+        return T.TransactionEnvelope.make(
+            T.EnvelopeType.ENVELOPE_TYPE_TX,
+            T.TransactionV1Envelope.make(tx=tx, signatures=[sig]))
+
+    # -- tx-based seeding (state-commitment-safe) ---------------------------
+
+    def root_key(self) -> SecretKey:
+        """The network root account key (standalone networks seed the
+        genesis balance at SecretKey(network_id), like the reference's
+        TestAccount::createRoot)."""
+        return SecretKey(self.network_id)
+
+    def create_account_envelopes(self, n: int, balance: int = 10**9,
+                                 prefix: bytes = b"loadgen",
+                                 batch: int = 100) -> List:
+        """CreateAccount transactions from the network root, ``batch``
+        ops per tx (ref LoadGenerator::createAccounts — REAL txs, so the
+        bucket-list commitment covers the seeded accounts; the bulk
+        create_accounts() writer is for in-process perf rigs only and
+        leaves the SQL tier ahead of the buckets)."""
+        root = self.root_key()
+        new = [SecretKey(sha256(prefix + b"-%d" % i)) for i in range(n)]
+        envs = []
+        for i in range(0, len(new), batch):
+            chunk = new[i:i + batch]
+            ops = [T.Operation.make(
+                sourceAccount=None,
+                body=T.OperationBody.make(
+                    T.OperationType.CREATE_ACCOUNT,
+                    T.CreateAccountOp.make(
+                        destination=T.account_id(sk.public_key().raw),
+                        startingBalance=balance)))
+                for sk in chunk]
+            envs.append(self._sign_tx(root, ops, 100 * len(ops)))
+        self.accounts.extend(new)
+        return envs
+
+    def create_dex_issuer_envelope(self) -> List:
+        """Stage A of DEX seeding: create the LOAD issuer (its OWN close
+        — apply order is hash-shuffled, so trustlines in the same ledger
+        could apply before the issuer exists and fail NO_ISSUER)."""
+        root = self.root_key()
+        issuer = SecretKey(sha256(b"loadgen-dex-issuer"))
+        self.dex_issuer = issuer
+        self.dex_asset = U.make_asset(b"LOAD", issuer.public_key().raw)
+        return [self._sign_tx(root, [T.Operation.make(
+            sourceAccount=None,
+            body=T.OperationBody.make(
+                T.OperationType.CREATE_ACCOUNT,
+                T.CreateAccountOp.make(
+                    destination=T.account_id(issuer.public_key().raw),
+                    startingBalance=10**9)))], 100)]
+
+    def setup_dex_envelopes(self, credit: int = 10**7,
+                            accounts: Optional[List[SecretKey]] = None
+                            ) -> List:
+        """Stage B of DEX seeding: one changeTrust per account (each
+        account signs its own; run AFTER the issuer-create tx closed)."""
+        accts = accounts or self.accounts
+        assert accts, "CREATE accounts first"
+        assert getattr(self, "dex_asset", None) is not None, \
+            "create_dex_issuer_envelope first"
+        envs = []
+        for sk in accts:
+            envs.append(self._sign_tx(sk, [T.Operation.make(
+                sourceAccount=None,
+                body=T.OperationBody.make(
+                    T.OperationType.CHANGE_TRUST,
+                    T.ChangeTrustOp.make(
+                        line=T.ChangeTrustAsset.make(
+                            self.dex_asset.type, self.dex_asset.value),
+                        limit=U.INT64_MAX)))], 100))
+        return envs
+
+    def fund_dex_envelopes(self, credit: int = 10**7, batch: int = 100,
+                           accounts: Optional[List[SecretKey]] = None
+                           ) -> List:
+        """Issuer payments funding every trustline (run AFTER the
+        setup_dex_envelopes txs have closed)."""
+        accts = accounts or self.accounts
+        envs = []
+        for i in range(0, len(accts), batch):
+            chunk = accts[i:i + batch]
+            ops = [T.Operation.make(
+                sourceAccount=None,
+                body=T.OperationBody.make(
+                    T.OperationType.PAYMENT,
+                    T.PaymentOp.make(
+                        destination=T.muxed_account(sk.public_key().raw),
+                        asset=self.dex_asset, amount=credit)))
+                for sk in chunk]
+            envs.append(self._sign_tx(self.dex_issuer, ops,
+                                      100 * len(ops)))
+        return envs

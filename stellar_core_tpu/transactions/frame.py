@@ -486,10 +486,10 @@ class TransactionFrame:
                 # that actually fail are marked opBAD_AUTH — passing ops
                 # keep the default-initialized opINNER success result
                 # (ref OperationFrame::checkSignature :194 + markResultFailed)
-                with LedgerTxn(pre_ltx) as probe:
-                    failed = [not opf.check_signatures(probe, checker)
-                              for opf in self.op_frames]
-                    probe.rollback()
+                # read-only probe (ref scopes a throwaway LedgerTxn; our
+                # check_signatures never writes, so probe pre_ltx direct)
+                failed = [not opf.check_signatures(pre_ltx, checker)
+                          for opf in self.op_frames]
                 if any(failed):
                     res = TC.txFAILED
                     ops_sig_results = [

@@ -303,9 +303,12 @@ class _StructValue:
         self._fields = _fields if type(_fields) is tuple else tuple(_fields)
         d = self.__dict__
         d.update(kw)
-        for f in self._fields:
-            if f not in d:
-                d[f] = None
+        # fast path: fully-specified construction (the hot case — every
+        # decode and most make() calls) skips the default-fill scan
+        if len(d) != len(self._fields):
+            for f in self._fields:
+                if f not in d:
+                    d[f] = None
 
     def __eq__(self, other):
         return (
@@ -344,6 +347,45 @@ class Struct(XdrType):
         self.field_names = tuple(f for f, _ in fields)
         # bound pack methods: the encode hot loop skips attribute dispatch
         self._packers = [(f, t.pack) for f, t in fields]
+        self._packfn = None  # compiled on first pack (fields may be
+        #                      patched during schema construction)
+
+    def _compile_packfn(self):
+        """exec-compile a packer: unrolled field sequence with runs of
+        primitive leaves FUSED into single struct.pack calls.  Encoding
+        is the close path's hottest loop (meta + bucket + SQL all encode
+        LedgerEntries); the fused packer cuts interpreter dispatch ~3x.
+        Wire layout identical by construction — struct formats map
+        int->'>i', uint->'>I', hyper->'>q', uhyper->'>Q', bool->'>I'."""
+        fmt_of = {IntType: "i", UintType: "I", HyperType: "q",
+                  UhyperType: "Q", BoolType: "I"}
+        ns = {"_sp": struct.pack}
+        lines = ["def _packfn(d, out):"]
+        run_fmt, run_args = "", []
+
+        def flush():
+            nonlocal run_fmt, run_args
+            if run_fmt:
+                lines.append(
+                    f"    out.append(_sp('>{run_fmt}', "
+                    f"{', '.join(run_args)}))")
+                run_fmt, run_args = "", []
+
+        for i, (fname, ftype) in enumerate(self.fields):
+            code = fmt_of.get(type(ftype))
+            if code is not None:
+                run_fmt += code
+                run_args.append(f"d[{fname!r}]")
+                continue
+            flush()
+            ns[f"_p{i}"] = ftype.pack
+            lines.append(f"    _p{i}(d[{fname!r}], out)")
+        flush()
+        if len(lines) == 1:
+            lines.append("    pass")
+        exec("\n".join(lines), ns)
+        self._packfn = ns["_packfn"]
+        return self._packfn
 
     def make(self, **kw):
         unknown = set(kw) - set(self.field_names)
@@ -355,36 +397,45 @@ class Struct(XdrType):
         return _StructValue(self.field_names,
                             **{f: t.default() for f, t in self.fields})
 
+    def _pack_slow(self, v, out):
+        """Per-field fallback with precise error attribution (also covers
+        namedtuple-like stand-ins without __dict__)."""
+        d = getattr(v, "__dict__", None)
+        for fname, fpack in self._packers:
+            try:
+                fpack(d[fname] if d is not None else getattr(v, fname),
+                      out)
+            except (KeyError, AttributeError, TypeError, XdrError) as e:
+                raise XdrError(f"{self.name}.{fname}: {e}") from e
+
     def pack(self, v, out):
         d = getattr(v, "__dict__", None)
         if d is None:  # e.g. a namedtuple-like stand-in
-            for fname, fpack in self._packers:
-                try:
-                    fpack(getattr(v, fname), out)
-                except (AttributeError, TypeError, XdrError) as e:
-                    raise XdrError(f"{self.name}.{fname}: {e}") from e
-            return
+            return self._pack_slow(v, out)
+        packfn = self._packfn or self._compile_packfn()
         if self.memoize:
             hit = d.get("_xdr_enc")
             if hit is not None and hit[0] is self:
                 out.append(hit[1])
                 return
             sub: List[bytes] = []
-            for fname, fpack in self._packers:
-                try:
-                    fpack(d[fname], sub)
-                except (KeyError, AttributeError, TypeError,
-                        XdrError) as e:
-                    raise XdrError(f"{self.name}.{fname}: {e}") from e
+            try:
+                packfn(d, sub)
+            except Exception:
+                sub = []
+                self._pack_slow(v, sub)  # re-raise with field context
             enc = b"".join(sub)
             d["_xdr_enc"] = (self, enc)
             out.append(enc)
             return
-        for fname, fpack in self._packers:
-            try:
-                fpack(d[fname], out)
-            except (KeyError, AttributeError, TypeError, XdrError) as e:
-                raise XdrError(f"{self.name}.{fname}: {e}") from e
+        n = len(out)
+        try:
+            packfn(d, out)
+        except Exception as e:
+            if isinstance(e, XdrError):
+                raise
+            del out[n:]  # drop partial output before the diagnosing retry
+            self._pack_slow(v, out)  # re-raises with field context
 
     def unpack(self, r):
         kw = {fname: ftype.unpack(r) for fname, ftype in self.fields}

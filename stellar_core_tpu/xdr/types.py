@@ -1585,3 +1585,8 @@ LedgerCloseMeta = Union("LedgerCloseMeta", Int, {
     0: ("v0", LedgerCloseMetaV0),
     1: ("v1", LedgerCloseMetaV1),
 })
+
+# results + metas are encoded 2-3x per close (result-set hash, txhistory
+# row, ledger-close meta stream) — cache the first encoding on the value
+TransactionResultPair.memoize = True
+TransactionMeta.memoize = True

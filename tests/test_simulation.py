@@ -170,3 +170,54 @@ def test_flood_dedup():
     # each node processed the tx exactly once despite the full mesh
     for a in apps:
         assert a.herder.tx_queue.size() == 1
+
+
+def test_loadgen_modes_and_generateload_route():
+    """PRETEND + MIXED_TXS load shapes flow through the real tx queue and
+    close; the generateload HTTP handler drives them (ref
+    LoadGenerator.h:28-36, CommandHandler.cpp:125)."""
+    from stellar_core_tpu.main import Application, test_config
+    from stellar_core_tpu.main.http_server import CommandHandler
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+    from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), test_config(
+        UPGRADE_DESIRED_MAX_TX_SET_SIZE=300))
+    app.start()
+    app.herder.manual_close()
+    handler = CommandHandler(app)
+    # staged seeding: create -> close -> trustlines -> close -> funding
+    # -> close -> load (every stage is REAL transactions so the bucket
+    # commitment covers the seeded state)
+    code, body = handler.handle("generateload",
+                                {"mode": "create", "accounts": "50"})
+    assert code == 200, body
+    app.herder.manual_close()
+    for _ in range(3):  # issuer, trustlines, funding stages
+        code, body = handler.handle(
+            "generateload", {"mode": "mixed", "txs": "120"})
+        assert code == 200 and "note" in body, body
+        app.herder.manual_close()
+        assert app.herder.tx_queue.size() == 0
+    code, body = handler.handle(
+        "generateload", {"mode": "mixed", "txs": "120", "dexpct": "40"})
+    assert code == 200, body
+    assert body["status_counts"] == {0: 120}
+    seq_before = app.ledger_manager.last_closed_seq()
+    app.herder.manual_close()
+    assert app.ledger_manager.last_closed_seq() == seq_before + 1
+    assert app.herder.tx_queue.size() == 0
+    # offers actually made it into the book
+    from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+
+    with LedgerTxn(app.ledger_manager.root) as ltx:
+        n_offers = app.database.execute(
+            "SELECT COUNT(*) FROM offers").fetchone()[0]
+        ltx.rollback()
+    assert n_offers > 0
+    code, body = handler.handle("generateload",
+                                {"mode": "pretend", "txs": "40"})
+    assert code == 200, body
+    assert body["status_counts"] == {0: 40}
+    app.herder.manual_close()
+    assert app.herder.tx_queue.size() == 0
