@@ -243,7 +243,13 @@ class LedgerManager:
         """DEFERRED_GC: young-gen collection after every close, full
         collection every 64 (the checkpoint cadence) — never during the
         close itself."""
-        if not self.app.config.DEFERRED_GC:
+        from ..main import application as app_mod
+
+        # collect whenever the process-global deferral is active, even if
+        # THIS app's config says False — once some app disabled automatic
+        # GC, any closing app must carry the collection duty or cyclic
+        # garbage grows unboundedly
+        if not (self.app.config.DEFERRED_GC or app_mod._GC_DEFERRED):
             return
         import gc
 

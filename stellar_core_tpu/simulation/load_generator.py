@@ -53,7 +53,14 @@ class LoadGenerator:
             with LedgerTxn(root) as ltx:
                 e = ltx.load_account(k)
                 ltx.rollback()
-            self._seqs[k] = e.data.value.seqNum if e else 0
+            if e is None:
+                # account not on-ledger yet (e.g. a seeding stage called
+                # before its close): the envelope will be rejected at
+                # admission — do NOT cache, or the eventual real seqnum
+                # (ledgerSeq<<32) would never be read and every retry
+                # would be a sequence gap
+                return 1
+            self._seqs[k] = e.data.value.seqNum
         self._seqs[k] += 1
         return self._seqs[k]
 
@@ -123,6 +130,13 @@ class LoadGenerator:
 
     # -- MIXED_TXS mode -----------------------------------------------------
 
+    def _derive_dex(self) -> None:
+        """One derivation for both seeding paths (bulk setup_dex and the
+        staged HTTP envelopes) so they can never diverge."""
+        issuer = SecretKey(sha256(b"loadgen-dex-issuer"))
+        self.dex_issuer = issuer
+        self.dex_asset = U.make_asset(b"LOAD", issuer.public_key().raw)
+
     def setup_dex(self, accounts: Optional[List[SecretKey]] = None,
                   credit: int = 10**7) -> None:
         """Seed the DEX leg of MIXED_TXS: a LOAD-asset issuer plus a
@@ -131,9 +145,8 @@ class LoadGenerator:
         accts = accounts or self.accounts
         assert accts, "CREATE accounts first"
         root = self.app.ledger_manager.root
-        issuer = SecretKey(sha256(b"loadgen-dex-issuer"))
-        self.dex_issuer = issuer
-        self.dex_asset = U.make_asset(b"LOAD", issuer.public_key().raw)
+        self._derive_dex()
+        issuer = self.dex_issuer
         with LedgerTxn(root) as ltx:
             if ltx.load_account(issuer.public_key().raw) is None:
                 ltx.put(U.make_account_entry(
@@ -253,9 +266,8 @@ class LoadGenerator:
         — apply order is hash-shuffled, so trustlines in the same ledger
         could apply before the issuer exists and fail NO_ISSUER)."""
         root = self.root_key()
-        issuer = SecretKey(sha256(b"loadgen-dex-issuer"))
-        self.dex_issuer = issuer
-        self.dex_asset = U.make_asset(b"LOAD", issuer.public_key().raw)
+        self._derive_dex()
+        issuer = self.dex_issuer
         return [self._sign_tx(root, [T.Operation.make(
             sourceAccount=None,
             body=T.OperationBody.make(

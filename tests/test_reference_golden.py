@@ -143,14 +143,15 @@ class RefHarness:
         self.seqs[pub] += 1
         return self.seqs[pub]
 
-    def tx(self, sk: SecretKey, ops, seq=None, extra_signers=()):
+    def tx(self, sk: SecretKey, ops, seq=None, extra_signers=(),
+           fee=None):
         """transactionFromOperationsV1: fee = ops * 100, no memo/bounds.
         ``extra_signers`` mirrors TestAccount::tx + addSignature."""
         pub = sk.public_key().raw
         tx = T.Transaction.make(
             sourceAccount=T.MuxedAccount.make(
                 T.CryptoKeyType.KEY_TYPE_ED25519, pub),
-            fee=len(ops) * self.txfee,
+            fee=len(ops) * self.txfee if fee is None else fee,
             seqNum=self._next_seq(pub) if seq is None else seq,
             cond=T.Preconditions.make(T.PreconditionType.PRECOND_NONE),
             memo=T.Memo.make(T.MemoType.MEMO_NONE),
@@ -1424,3 +1425,238 @@ class TestClawbackClaimableBalanceBaselines:
         assert_section(
             d, "clawbackClaimableBalance|protocol version 19|basic test",
             metas)
+
+
+class TestPathPaymentBaselines:
+    """pathpayment|protocol version 19|issuer missing|path payment middle
+    issuer missing (PathPaymentTests.cpp:663-712)."""
+
+    def test_middle_issuer_missing(self):
+        d = load_baseline("PathPaymentTests.json")
+        h = RefHarness()
+        gate = SecretKey(named_account_seed("gate"))
+        gate2 = SecretKey(named_account_seed("gate2"))
+        min_balance2 = h.min_balance(2) + 10 * h.txfee
+        min_balance3 = h.min_balance(3) + 10 * h.txfee
+        gateway_payment = min_balance2 + min_balance3 // 2
+        for sk in (gate, gate2):
+            h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+                sk.public_key().raw, gateway_payment)]))
+        idr = h.asset(gate.public_key().raw, b"IDR")
+        usd = h.asset(gate2.public_key().raw, b"USD")
+        # section fixture (parent key): source/destination + trusts + pay
+        src = SecretKey(named_account_seed("source"))
+        dst = SecretKey(named_account_seed("destination"))
+        min_balance1 = h.min_balance(1) + 10 * h.txfee
+        for sk in (src, dst):
+            h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+                sk.public_key().raw, min_balance1)]))
+        h.apply_tx(h.tx(src, [h.op_change_trust(idr, 20)]))
+        h.apply_tx(h.tx(dst, [h.op_change_trust(usd, 20)]))
+        h.apply_tx(h.tx(gate, [h.op_payment(
+            src.public_key().raw, 10, asset=idr)]))
+        # leaf: strict-receive through a path whose middle issuer is gone
+        btc = h.asset(SecretKey(
+            named_account_seed("missing")).public_key().raw, b"BTC")
+        op = h._op(T.OperationType.PATH_PAYMENT_STRICT_RECEIVE,
+                   T.PathPaymentStrictReceiveOp.make(
+                       sendAsset=idr, sendMax=11,
+                       destination=T.MuxedAccount.make(
+                           T.CryptoKeyType.KEY_TYPE_ED25519,
+                           dst.public_key().raw),
+                       destAsset=usd, destAmount=11, path=[btc]))
+        res, meta = h.apply_tx(h.tx(src, [op]))
+        opr = res.result.result.value[0]
+        assert opr.value.value.type == \
+            T.PathPaymentStrictReceiveResultCode.\
+            PATH_PAYMENT_STRICT_RECEIVE_TOO_FEW_OFFERS
+        assert_section(
+            d, "pathpayment|protocol version 19|issuer missing|"
+               "path payment middle issuer missing", [meta])
+
+
+class TestPathPaymentStrictSendBaselines:
+    """pathpayment strict send|protocol version 19|issuer missing|path
+    payment middle issuer missing (PathPaymentStrictSendTests.cpp:563-612)."""
+
+    def test_middle_issuer_missing(self):
+        d = load_baseline("PathPaymentStrictSendTests.json")
+        h = RefHarness()
+        gate = SecretKey(named_account_seed("gate1"))
+        gate2 = SecretKey(named_account_seed("gate2"))
+        min_balance5 = h.min_balance(5) + 10 * h.txfee
+        for sk in (gate, gate2):
+            h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+                sk.public_key().raw, min_balance5)]))
+        idr = h.asset(gate.public_key().raw, b"IDR")
+        usd = h.asset(gate2.public_key().raw, b"USD")
+        src = SecretKey(named_account_seed("source"))
+        dst = SecretKey(named_account_seed("destination"))
+        min_balance1 = h.min_balance(1) + 10 * h.txfee
+        for sk in (src, dst):
+            h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+                sk.public_key().raw, min_balance1)]))
+        h.apply_tx(h.tx(src, [h.op_change_trust(idr, 20)]))
+        h.apply_tx(h.tx(dst, [h.op_change_trust(usd, 20)]))
+        h.apply_tx(h.tx(gate, [h.op_payment(
+            src.public_key().raw, 10, asset=idr)]))
+        btc = h.asset(SecretKey(
+            named_account_seed("missing")).public_key().raw, b"BTC")
+        op = h._op(T.OperationType.PATH_PAYMENT_STRICT_SEND,
+                   T.PathPaymentStrictSendOp.make(
+                       sendAsset=idr, sendAmount=10,
+                       destination=T.MuxedAccount.make(
+                           T.CryptoKeyType.KEY_TYPE_ED25519,
+                           dst.public_key().raw),
+                       destAsset=usd, destMin=10, path=[btc]))
+        res, meta = h.apply_tx(h.tx(src, [op]))
+        opr = res.result.result.value[0]
+        assert opr.value.value.type == \
+            T.PathPaymentStrictSendResultCode.\
+            PATH_PAYMENT_STRICT_SEND_TOO_FEW_OFFERS
+        assert_section(
+            d, "pathpayment strict send|protocol version 19|"
+               "issuer missing|path payment middle issuer missing",
+            [meta])
+
+
+class TestTxEnvelopeBaselines:
+    """txenvelope|protocol version 19|batching|empty batch
+    (TxEnvelopeTests.cpp:1680-1696): a zero-op tx with fee 1000 fails
+    txMISSING_OPERATION at apply and still records its (empty) meta."""
+
+    def test_empty_batch(self):
+        d = load_baseline("TxEnvelopeTests.json")
+        h = RefHarness()
+        env = h.tx(h.root_sk, [], fee=1000)
+        res, meta = h.apply_tx(env)
+        assert res.result.result.type == \
+            T.TransactionResultCode.txMISSING_OPERATION
+        assert_section(
+            d, "txenvelope|protocol version 19|batching|empty batch",
+            [meta])
+
+
+class TestLiquidityPoolWithdrawBaselines:
+    """liquidity pool withdraw|protocol version 19|malformed
+    (LiquidityPoolWithdrawTests.cpp:1-45)."""
+
+    def test_malformed(self):
+        d = load_baseline("LiquidityPoolWithdrawTests.json")
+        h = RefHarness()
+        acc1 = SecretKey(named_account_seed("acc1"))
+        h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+            acc1.public_key().raw, h.min_balance(10))]))
+        LW = T.LiquidityPoolWithdrawResultCode
+        metas = []
+        for amount, min_a, min_b in ((0, 1, 1), (1, -1, 1), (1, 1, -1)):
+            op = h._op(T.OperationType.LIQUIDITY_POOL_WITHDRAW,
+                       T.LiquidityPoolWithdrawOp.make(
+                           liquidityPoolID=b"\x00" * 32,
+                           amount=amount, minAmountA=min_a,
+                           minAmountB=min_b))
+            res, meta = h.apply_tx(h.tx(acc1, [op]))
+            opr = res.result.result.value[0]
+            assert opr.value.value.type == \
+                LW.LIQUIDITY_POOL_WITHDRAW_MALFORMED
+            metas.append(meta)
+        assert_section(
+            d, "liquidity pool withdraw|protocol version 19|malformed",
+            metas)
+
+
+class TestLiquidityPoolDepositBaselines:
+    """liquidity pool deposit|protocol version 19|validity checks
+    (LiquidityPoolDepositTests.cpp:45-95): 13 MALFORMED deposits from the
+    root account (no fixture)."""
+
+    def test_validity_checks(self):
+        d = load_baseline("LiquidityPoolDepositTests.json")
+        h = RefHarness()
+        LD = T.LiquidityPoolDepositResultCode
+        cases = [
+            (0, 100, (1, 1), (1, 1)), (-1, 100, (1, 1), (1, 1)),
+            (100, 0, (1, 1), (1, 1)), (100, -1, (1, 1), (1, 1)),
+            (100, 100, (0, 1), (1, 1)), (100, 100, (-1, 1), (1, 1)),
+            (100, 100, (1, 0), (1, 1)), (100, 100, (1, -1), (1, 1)),
+            (100, 100, (1, 1), (0, 1)), (100, 100, (1, 1), (-1, 1)),
+            (100, 100, (1, 1), (1, 0)), (100, 100, (1, 1), (1, -1)),
+            (100, 100, (2, 1), (1, 1)),
+        ]
+        metas = []
+        for max_a, max_b, min_p, max_p in cases:
+            op = h._op(T.OperationType.LIQUIDITY_POOL_DEPOSIT,
+                       T.LiquidityPoolDepositOp.make(
+                           liquidityPoolID=b"\x00" * 32,
+                           maxAmountA=max_a, maxAmountB=max_b,
+                           minPrice=T.Price.make(n=min_p[0], d=min_p[1]),
+                           maxPrice=T.Price.make(n=max_p[0], d=max_p[1])))
+            res, meta = h.apply_tx(h.tx(h.root_sk, [op]))
+            opr = res.result.result.value[0]
+            assert opr.value.value.type == \
+                LD.LIQUIDITY_POOL_DEPOSIT_MALFORMED, (max_a, max_b)
+            metas.append(meta)
+        assert_section(
+            d, "liquidity pool deposit|protocol version 19|"
+               "validity checks", metas)
+
+
+class TestLiquidityPoolTradeBaselines:
+    """liquidity pool trade|protocol version 19|CUR1, CUR2|payment through
+    a pool that the sender participates in|strict receive
+    (LiquidityPoolTradeTests.cpp:410-435, 1203-1206): a real pool deposit
+    followed by a strict-receive path payment routed through the pool."""
+
+    def test_sender_participates_strict_receive(self):
+        d = load_baseline("LiquidityPoolTradeTests.json")
+        h = RefHarness()
+        rpub = h.root_sk.public_key().raw
+        cur1 = h.asset(rpub, b"CUR1")
+        cur2 = h.asset(rpub, b"CUR2")
+        params = T.LiquidityPoolConstantProductParameters.make(
+            assetA=cur1, assetB=cur2, fee=T.LIQUIDITY_POOL_FEE_V18)
+        lp_params = T.LiquidityPoolParameters.make(
+            T.LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT, params)
+        share12 = T.ChangeTrustAsset.make(
+            T.AssetType.ASSET_TYPE_POOL_SHARE, lp_params)
+        pool12 = sha256(T.LiquidityPoolParameters.encode(lp_params))
+        a1 = SecretKey(named_account_seed("a1"))
+        a2 = SecretKey(named_account_seed("a2"))
+        apub, a2pub = a1.public_key().raw, a2.public_key().raw
+        h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+            apub, h.min_balance(10))]))
+        h.apply_tx(h.tx(a1, [h.op_change_trust(cur1, INT64_MAX)]))
+        h.apply_tx(h.tx(a1, [h.op_change_trust(cur2, INT64_MAX)]))
+        h.apply_tx(h.tx(a1, [h.op_change_trust(share12, INT64_MAX)]))
+        h.apply_tx(h.tx(h.root_sk, [h.op_payment(apub, 10000,
+                                                 asset=cur1)]))
+        h.apply_tx(h.tx(h.root_sk, [h.op_payment(apub, 10000,
+                                                 asset=cur2)]))
+        dep = h._op(T.OperationType.LIQUIDITY_POOL_DEPOSIT,
+                    T.LiquidityPoolDepositOp.make(
+                        liquidityPoolID=pool12,
+                        maxAmountA=1000, maxAmountB=1000,
+                        minPrice=T.Price.make(n=1, d=2**31 - 1),
+                        maxPrice=T.Price.make(n=2**31 - 1, d=1)))
+        res, _ = h.apply_tx(h.tx(a1, [dep]))
+        assert res.result.result.value[0].value.value.type == \
+            T.LiquidityPoolDepositResultCode.LIQUIDITY_POOL_DEPOSIT_SUCCESS
+        h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+            a2pub, h.min_balance(10))]))
+        h.apply_tx(h.tx(a2, [h.op_change_trust(cur2, INT64_MAX)]))
+        # leaf: strict receive cur1 -> cur2 through the pool
+        op = h._op(T.OperationType.PATH_PAYMENT_STRICT_RECEIVE,
+                   T.PathPaymentStrictReceiveOp.make(
+                       sendAsset=cur1, sendMax=10,
+                       destination=T.MuxedAccount.make(
+                           T.CryptoKeyType.KEY_TYPE_ED25519, a2pub),
+                       destAsset=cur2, destAmount=9, path=[]))
+        res, meta = h.apply_tx(h.tx(a1, [op]))
+        opr = res.result.result.value[0]
+        assert opr.value.value.type == \
+            T.PathPaymentStrictReceiveResultCode.\
+            PATH_PAYMENT_STRICT_RECEIVE_SUCCESS
+        assert_section(
+            d, "liquidity pool trade|protocol version 19|CUR1, CUR2|"
+               "payment through a pool that the sender participates in|"
+               "strict receive", [meta])
