@@ -81,6 +81,7 @@ class Application:
 
     def start(self) -> None:
         self.config.validate()
+        T.ensure_native_encode()  # build once per checkout, cached .so
         if self.config.DEFERRED_GC:
             # low-latency close discipline: a gen-2 cycle collection can
             # stall the single-threaded close loop for >1s (measured:

@@ -96,3 +96,54 @@ def get_lib() -> Optional[ctypes.CDLL]:
         ]
         _lib = lib
         return _lib
+
+
+# -- native XDR packer (CPython extension) -------------------------------
+
+_XDRPACK_SRC = os.path.join(_DIR, "xdr_pack.c")
+_XDRPACK_SO = os.path.join(_DIR, "_xdrpack.so")
+_xdrpack_mod = None
+_xdrpack_tried = False
+
+
+def get_xdrpack(build: bool = True):
+    """The _xdrpack extension module (schema-driven XDR encoder); with
+    ``build=False`` only an already-built fresh .so is loaded (imports
+    stay cheap — node startup triggers the build).  None when
+    unavailable."""
+    global _xdrpack_mod, _xdrpack_tried
+    with _lock:
+        if _xdrpack_mod is not None or _xdrpack_tried:
+            return _xdrpack_mod
+        try:
+            import sysconfig
+
+            stale = (not os.path.exists(_XDRPACK_SO)
+                     or os.path.getmtime(_XDRPACK_SO)
+                     < os.path.getmtime(_XDRPACK_SRC))
+            if stale and not build:
+                return None  # not tried: a build=True caller may succeed
+            _xdrpack_tried = True
+            if stale:
+                inc = sysconfig.get_paths()["include"]
+                # pid-unique tmp: concurrent first-builds must not
+                # interleave into one file and install a torn .so
+                tmp = f"{_XDRPACK_SO}.{os.getpid()}.tmp"
+                r = subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-I", inc,
+                     "-o", tmp, _XDRPACK_SRC],
+                    capture_output=True, timeout=120)
+                if r.returncode != 0:
+                    return None
+                os.replace(tmp, _XDRPACK_SO)
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(
+                "_xdrpack", _XDRPACK_SO)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _xdrpack_mod = mod
+        except Exception:
+            _xdrpack_mod = None
+            _xdrpack_tried = True
+        return _xdrpack_mod

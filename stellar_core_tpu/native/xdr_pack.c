@@ -1,0 +1,568 @@
+/* Native XDR encoder: a schema-driven packer for the combinator runtime
+ * (stellar_core_tpu/xdr/runtime.py).  The Python side compiles each
+ * XdrType tree into a flat node table (see runtime._compile_native_schema)
+ * and hands it over once; pack(idx, value) then walks plain Python
+ * objects (_StructValue.__dict__ / _UnionValue slots) in C, emitting the
+ * canonical big-endian stream.
+ *
+ * This is the host-runtime analog of the reference's xdrpp codegen tier:
+ * encoding dominates the ledger-close profile (meta + result + bucket +
+ * SQL all serialize XDR), and the interpreted combinator walk was ~40%
+ * of a 1000-tx close.  Wire bytes are identical by construction; the
+ * Python packer stays as the differential oracle and fallback.
+ *
+ * Node kinds mirror the runtime combinators:
+ *   0 INT32  1 UINT32  2 INT64  3 UINT64  4 BOOL
+ *   5 OPAQUE_FIX(n)    6 OPAQUE_VAR(max)
+ *   7 STRUCT(fields)   8 UNION(arms)      9 ARR_FIX(n, elem)
+ *  10 ARR_VAR(max, elem)  11 OPTION(elem)  12 ENUM(valid-set)
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+enum {
+    K_INT32 = 0, K_UINT32, K_INT64, K_UINT64, K_BOOL,
+    K_OPAQUE_FIX, K_OPAQUE_VAR, K_STRUCT, K_UNION, K_ARR_FIX,
+    K_ARR_VAR, K_OPTION, K_ENUM
+};
+
+typedef struct {
+    PyObject *name;   /* interned field name */
+    int32_t type_idx;
+} Field;
+
+typedef struct {
+    int32_t has_arm;  /* 0 = void */
+    int32_t type_idx;
+} Arm;
+
+typedef struct {
+    int kind;
+    int64_t n;            /* fixed len / max len / field count */
+    Field *fields;        /* K_STRUCT */
+    PyObject *arm_map;    /* K_UNION: dict disc -> (has_arm, idx) or None */
+    Arm default_arm;      /* K_UNION: used when arm_map misses */
+    int has_default;
+    int32_t elem;         /* arrays / option */
+    PyObject *valid;      /* K_ENUM: frozenset of valid values */
+    PyObject *memo_key;   /* the Python XdrType object for memo identity,
+                             or NULL when not memoized */
+} Node;
+
+typedef struct {
+    char *buf;
+    Py_ssize_t len, cap;
+} Out;
+
+static PyObject *XdrErrorCls;   /* set at init_schema */
+static Node *g_nodes;
+static Py_ssize_t g_count;
+
+static int
+out_reserve(Out *o, Py_ssize_t extra)
+{
+    if (o->len + extra <= o->cap)
+        return 0;
+    Py_ssize_t ncap = o->cap ? o->cap * 2 : 512;
+    while (ncap < o->len + extra)
+        ncap *= 2;
+    char *nb = (char *)PyMem_Realloc(o->buf, ncap);
+    if (!nb) {
+        PyErr_NoMemory();
+        return -1;
+    }
+    o->buf = nb;
+    o->cap = ncap;
+    return 0;
+}
+
+static inline int
+emit_u32(Out *o, uint32_t v)
+{
+    if (out_reserve(o, 4) < 0)
+        return -1;
+    o->buf[o->len++] = (char)(v >> 24);
+    o->buf[o->len++] = (char)(v >> 16);
+    o->buf[o->len++] = (char)(v >> 8);
+    o->buf[o->len++] = (char)v;
+    return 0;
+}
+
+static inline int
+emit_u64(Out *o, uint64_t v)
+{
+    if (emit_u32(o, (uint32_t)(v >> 32)) < 0)
+        return -1;
+    return emit_u32(o, (uint32_t)v);
+}
+
+static int
+emit_bytes(Out *o, const char *p, Py_ssize_t n, int pad)
+{
+    Py_ssize_t padded = pad ? (n + 3) & ~(Py_ssize_t)3 : n;
+    if (out_reserve(o, padded) < 0)
+        return -1;
+    memcpy(o->buf + o->len, p, n);
+    if (padded > n)
+        memset(o->buf + o->len + n, 0, padded - n);
+    o->len += padded;
+    return 0;
+}
+
+static int pack_node(int32_t idx, PyObject *v, Out *o);
+
+static int
+err(const char *msg)
+{
+    PyErr_SetString(XdrErrorCls, msg);
+    return -1;
+}
+
+static int
+pack_long_checked(PyObject *v, int64_t lo_is_min64, uint64_t hi, int is64,
+                  int is_signed, Out *o)
+{
+    int overflow = 0;
+    long long x;
+    if (!PyLong_Check(v)) {
+        if (PyBool_Check(v))
+            x = (v == Py_True);
+        else
+            return err("expected int");
+        overflow = 0;
+    } else {
+        x = PyLong_AsLongLongAndOverflow(v, &overflow);
+        if (x == -1 && PyErr_Occurred())
+            return -1;
+    }
+    if (is_signed) {
+        if (overflow)
+            return err("int out of range");
+        if (!is64 && (x < INT32_MIN || x > INT32_MAX))
+            return err("int out of range");
+        if (is64)
+            return emit_u64(o, (uint64_t)x);
+        return emit_u32(o, (uint32_t)(int32_t)x);
+    }
+    /* unsigned */
+    if (overflow > 0 || x < 0) {
+        if (overflow > 0 && is64) {
+            /* 2^63..2^64-1: retake as unsigned */
+            unsigned long long ux = PyLong_AsUnsignedLongLong(v);
+            if (ux == (unsigned long long)-1 && PyErr_Occurred()) {
+                PyErr_Clear();
+                return err("int out of range");
+            }
+            return emit_u64(o, (uint64_t)ux);
+        }
+        return err("int out of range");
+    }
+    if (overflow)
+        return err("int out of range");
+    if (!is64 && (uint64_t)x > hi)
+        return err("int out of range");
+    if (is64)
+        return emit_u64(o, (uint64_t)x);
+    return emit_u32(o, (uint32_t)x);
+}
+
+static int
+pack_struct(Node *nd, PyObject *v, Out *o)
+{
+    PyObject *d = PyObject_GetAttrString(v, "__dict__");
+    if (!d)
+        return -1;
+    if (!PyDict_Check(d)) {
+        Py_DECREF(d);
+        return err("struct value has no dict");
+    }
+    for (int64_t i = 0; i < nd->n; i++) {
+        PyObject *fv = PyDict_GetItemWithError(d, nd->fields[i].name);
+        if (!fv) {
+            Py_DECREF(d);
+            if (!PyErr_Occurred())
+                PyErr_Format(XdrErrorCls, "missing struct field %U",
+                             nd->fields[i].name);
+            return -1;
+        }
+        if (pack_node(nd->fields[i].type_idx, fv, o) < 0) {
+            Py_DECREF(d);
+            return -1;
+        }
+    }
+    Py_DECREF(d);
+    return 0;
+}
+
+static int
+pack_union(Node *nd, PyObject *v, Out *o)
+{
+    PyObject *disc = PyObject_GetAttrString(v, "type");
+    if (!disc)
+        return -1;
+    long long dv = PyLong_AsLongLong(disc);
+    if (dv == -1 && PyErr_Occurred()) {
+        Py_DECREF(disc);
+        return -1;
+    }
+    if (nd->valid) {
+        int c = PySet_Contains(nd->valid, disc);
+        if (c < 0) {
+            Py_DECREF(disc);
+            return -1;
+        }
+        if (!c) {
+            Py_DECREF(disc);
+            return err("bad enum value for union discriminant");
+        }
+    }
+    int has_arm;
+    int32_t arm_idx;
+    PyObject *ent = PyDict_GetItemWithError(nd->arm_map, disc);
+    Py_DECREF(disc);
+    if (ent) {
+        has_arm = PyLong_AsLong(PyTuple_GET_ITEM(ent, 0));
+        arm_idx = (int32_t)PyLong_AsLong(PyTuple_GET_ITEM(ent, 1));
+    } else if (PyErr_Occurred()) {
+        return -1;
+    } else if (nd->has_default) {
+        has_arm = nd->default_arm.has_arm;
+        arm_idx = nd->default_arm.type_idx;
+    } else {
+        return err("no union arm for discriminant");
+    }
+    if (dv < INT32_MIN || dv > INT32_MAX)
+        return err("union discriminant out of range");
+    if (emit_u32(o, (uint32_t)(int32_t)dv) < 0)
+        return -1;
+    if (has_arm) {
+        PyObject *av = PyObject_GetAttrString(v, "value");
+        if (!av)
+            return -1;
+        int r = pack_node(arm_idx, av, o);
+        Py_DECREF(av);
+        return r;
+    } else {
+        PyObject *av = PyObject_GetAttrString(v, "value");
+        if (!av)
+            return -1;
+        int bad = (av != Py_None);
+        Py_DECREF(av);
+        if (bad)
+            return err("void arm carries a value");
+    }
+    return 0;
+}
+
+static int
+pack_node(int32_t idx, PyObject *v, Out *o)
+{
+    Node *nd = &g_nodes[idx];
+    switch (nd->kind) {
+    case K_INT32:
+        return pack_long_checked(v, 0, 0, 0, 1, o);
+    case K_UINT32:
+        return pack_long_checked(v, 0, UINT32_MAX, 0, 0, o);
+    case K_INT64:
+        return pack_long_checked(v, 0, 0, 1, 1, o);
+    case K_UINT64:
+        return pack_long_checked(v, 0, UINT64_MAX, 1, 0, o);
+    case K_BOOL: {
+        int t = PyObject_IsTrue(v);
+        if (t < 0)
+            return -1;
+        return emit_u32(o, (uint32_t)t);
+    }
+    case K_ENUM: {
+        int c = PySet_Contains(nd->valid, v);
+        if (c < 0)
+            return -1;
+        if (!c)
+            return err("bad enum value");
+        long long x = PyLong_AsLongLong(v);
+        if (x == -1 && PyErr_Occurred())
+            return -1;
+        return emit_u32(o, (uint32_t)(int32_t)x);
+    }
+    case K_OPAQUE_FIX: {
+        /* mirror Opaque.pack: len(v) first, then bytes(v) coercion
+         * (bytearray/memoryview accepted; int rejected by len()) */
+        Py_ssize_t n = PyObject_Length(v);
+        if (n < 0) {
+            PyErr_Clear();
+            return err("opaque expects a bytes-like value");
+        }
+        if (n != nd->n)
+            return err("opaque length mismatch");
+        PyObject *b = PyBytes_Check(v) ? Py_NewRef(v)
+                                       : PyBytes_FromObject(v);
+        if (!b) {
+            PyErr_Clear();
+            return err("opaque expects a bytes-like value");
+        }
+        int r = emit_bytes(o, PyBytes_AS_STRING(b),
+                           PyBytes_GET_SIZE(b), 1);
+        Py_DECREF(b);
+        return r;
+    }
+    case K_OPAQUE_VAR: {
+        Py_ssize_t n = PyObject_Length(v);
+        if (n < 0) {
+            PyErr_Clear();
+            return err("opaque expects a bytes-like value");
+        }
+        if ((uint64_t)n > (uint64_t)nd->n)
+            return err("opaque too long");
+        PyObject *b = PyBytes_Check(v) ? Py_NewRef(v)
+                                       : PyBytes_FromObject(v);
+        if (!b) {
+            PyErr_Clear();
+            return err("opaque expects a bytes-like value");
+        }
+        if (emit_u32(o, (uint32_t)n) < 0) {
+            Py_DECREF(b);
+            return -1;
+        }
+        int r = emit_bytes(o, PyBytes_AS_STRING(b),
+                           PyBytes_GET_SIZE(b), 1);
+        Py_DECREF(b);
+        return r;
+    }
+    case K_STRUCT: {
+        if (nd->memo_key) {
+            /* memoized: reuse / populate the value-side cache exactly
+             * like Struct.pack does ('_xdr_enc' dict entry) */
+            PyObject *d = PyObject_GetAttrString(v, "__dict__");
+            if (!d)
+                return -1;
+            PyObject *hit = PyDict_GetItemString(d, "_xdr_enc");
+            if (hit && PyTuple_Check(hit) &&
+                PyTuple_GET_ITEM(hit, 0) == nd->memo_key) {
+                PyObject *enc = PyTuple_GET_ITEM(hit, 1);
+                int r = emit_bytes(o, PyBytes_AS_STRING(enc),
+                                   PyBytes_GET_SIZE(enc), 0);
+                Py_DECREF(d);
+                return r;
+            }
+            Py_ssize_t start = o->len;
+            if (pack_struct(nd, v, o) < 0) {
+                Py_DECREF(d);
+                return -1;
+            }
+            PyObject *enc = PyBytes_FromStringAndSize(o->buf + start,
+                                                      o->len - start);
+            if (enc) {
+                PyObject *tup = PyTuple_Pack(2, nd->memo_key, enc);
+                if (tup) {
+                    PyDict_SetItemString(d, "_xdr_enc", tup);
+                    Py_DECREF(tup);
+                }
+                Py_DECREF(enc);
+            } else {
+                PyErr_Clear();
+            }
+            Py_DECREF(d);
+            return 0;
+        }
+        return pack_struct(nd, v, o);
+    }
+    case K_UNION: {
+        if (nd->memo_key) {
+            PyObject *hit = PyObject_GetAttrString(v, "_enc");
+            if (!hit)
+                return -1;
+            if (PyTuple_Check(hit) &&
+                PyTuple_GET_ITEM(hit, 0) == nd->memo_key) {
+                PyObject *enc = PyTuple_GET_ITEM(hit, 1);
+                int r = emit_bytes(o, PyBytes_AS_STRING(enc),
+                                   PyBytes_GET_SIZE(enc), 0);
+                Py_DECREF(hit);
+                return r;
+            }
+            Py_DECREF(hit);
+            Py_ssize_t start = o->len;
+            if (pack_union(nd, v, o) < 0)
+                return -1;
+            PyObject *enc = PyBytes_FromStringAndSize(o->buf + start,
+                                                      o->len - start);
+            if (enc) {
+                PyObject *tup = PyTuple_Pack(2, nd->memo_key, enc);
+                if (tup) {
+                    if (PyObject_SetAttrString(v, "_enc", tup) < 0)
+                        PyErr_Clear();
+                    Py_DECREF(tup);
+                }
+                Py_DECREF(enc);
+            } else {
+                PyErr_Clear();
+            }
+            return 0;
+        }
+        return pack_union(nd, v, o);
+    }
+    case K_ARR_FIX: {
+        PyObject *seq = PySequence_Fast(v, "array expects a sequence");
+        if (!seq)
+            return -1;
+        Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+        if (n != nd->n) {
+            Py_DECREF(seq);
+            return err("bad array length");
+        }
+        for (Py_ssize_t i = 0; i < n; i++) {
+            if (pack_node(nd->elem, PySequence_Fast_GET_ITEM(seq, i),
+                          o) < 0) {
+                Py_DECREF(seq);
+                return -1;
+            }
+        }
+        Py_DECREF(seq);
+        return 0;
+    }
+    case K_ARR_VAR: {
+        PyObject *seq = PySequence_Fast(v, "array expects a sequence");
+        if (!seq)
+            return -1;
+        Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+        if ((uint64_t)n > (uint64_t)nd->n) {
+            Py_DECREF(seq);
+            return err("array too long");
+        }
+        if (emit_u32(o, (uint32_t)n) < 0) {
+            Py_DECREF(seq);
+            return -1;
+        }
+        for (Py_ssize_t i = 0; i < n; i++) {
+            if (pack_node(nd->elem, PySequence_Fast_GET_ITEM(seq, i),
+                          o) < 0) {
+                Py_DECREF(seq);
+                return -1;
+            }
+        }
+        Py_DECREF(seq);
+        return 0;
+    }
+    case K_OPTION: {
+        if (v == Py_None)
+            return emit_u32(o, 0);
+        if (emit_u32(o, 1) < 0)
+            return -1;
+        return pack_node(nd->elem, v, o);
+    }
+    }
+    return err("corrupt schema node");
+}
+
+/* init_schema(nodes, xdr_error_cls)
+ * nodes: list of tuples
+ *   (kind, n, fields, arm_map, default_arm, elem, valid, memo_key)
+ *   fields: tuple of (name, idx) or None
+ *   arm_map: dict {disc: (has_arm, idx)} or None
+ *   default_arm: (has_arm, idx) or None
+ */
+static PyObject *
+py_init_schema(PyObject *self, PyObject *args)
+{
+    PyObject *nodes, *errcls;
+    if (!PyArg_ParseTuple(args, "OO", &nodes, &errcls))
+        return NULL;
+    if (g_nodes) {
+        PyErr_SetString(PyExc_RuntimeError,
+                        "xdr_pack schema already initialized");
+        return NULL;
+    }
+    Py_ssize_t count = PyList_GET_SIZE(nodes);
+    Node *tab = (Node *)PyMem_Calloc(count, sizeof(Node));
+    if (!tab)
+        return PyErr_NoMemory();
+    for (Py_ssize_t i = 0; i < count; i++) {
+        PyObject *t = PyList_GET_ITEM(nodes, i);
+        Node *nd = &tab[i];
+        nd->kind = (int)PyLong_AsLong(PyTuple_GET_ITEM(t, 0));
+        nd->n = PyLong_AsLongLong(PyTuple_GET_ITEM(t, 1));
+        PyObject *fields = PyTuple_GET_ITEM(t, 2);
+        if (fields != Py_None) {
+            Py_ssize_t nf = PyTuple_GET_SIZE(fields);
+            nd->n = nf;
+            nd->fields = (Field *)PyMem_Calloc(nf, sizeof(Field));
+            for (Py_ssize_t j = 0; j < nf; j++) {
+                PyObject *f = PyTuple_GET_ITEM(fields, j);
+                PyObject *nm = PyTuple_GET_ITEM(f, 0);
+                Py_INCREF(nm);
+                nd->fields[j].name = nm;
+                nd->fields[j].type_idx =
+                    (int32_t)PyLong_AsLong(PyTuple_GET_ITEM(f, 1));
+            }
+        }
+        PyObject *arm_map = PyTuple_GET_ITEM(t, 3);
+        if (arm_map != Py_None) {
+            Py_INCREF(arm_map);
+            nd->arm_map = arm_map;
+        }
+        PyObject *defarm = PyTuple_GET_ITEM(t, 4);
+        if (defarm != Py_None) {
+            nd->has_default = 1;
+            nd->default_arm.has_arm =
+                (int)PyLong_AsLong(PyTuple_GET_ITEM(defarm, 0));
+            nd->default_arm.type_idx =
+                (int32_t)PyLong_AsLong(PyTuple_GET_ITEM(defarm, 1));
+        }
+        nd->elem = (int32_t)PyLong_AsLong(PyTuple_GET_ITEM(t, 5));
+        PyObject *valid = PyTuple_GET_ITEM(t, 6);
+        if (valid != Py_None) {
+            Py_INCREF(valid);
+            nd->valid = valid;
+        }
+        PyObject *memo = PyTuple_GET_ITEM(t, 7);
+        if (memo != Py_None) {
+            Py_INCREF(memo);
+            nd->memo_key = memo;
+        }
+    }
+    Py_INCREF(errcls);
+    XdrErrorCls = errcls;
+    g_nodes = tab;
+    g_count = count;
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+py_pack(PyObject *self, PyObject *args)
+{
+    Py_ssize_t idx;
+    PyObject *v;
+    if (!PyArg_ParseTuple(args, "nO", &idx, &v))
+        return NULL;
+    if (!g_nodes || idx < 0 || idx >= g_count) {
+        PyErr_SetString(PyExc_RuntimeError, "schema not initialized");
+        return NULL;
+    }
+    Out o = {NULL, 0, 0};
+    if (pack_node((int32_t)idx, v, &o) < 0) {
+        PyMem_Free(o.buf);
+        return NULL;
+    }
+    PyObject *res = PyBytes_FromStringAndSize(o.buf, o.len);
+    PyMem_Free(o.buf);
+    return res;
+}
+
+static PyMethodDef methods[] = {
+    {"init_schema", py_init_schema, METH_VARARGS,
+     "Install the compiled node table (one-shot)."},
+    {"pack", py_pack, METH_VARARGS,
+     "pack(type_index, value) -> canonical XDR bytes."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_xdrpack", NULL, -1, methods,
+};
+
+PyMODINIT_FUNC
+PyInit__xdrpack(void)
+{
+    return PyModule_Create(&moduledef);
+}
