@@ -36,65 +36,75 @@ class SignatureChecker:
     def check_signature(self, signers: List[Tuple[object, int]],
                         needed_weight: int) -> bool:
         """signers: [(SignerKey value, weight)]; consume matching signatures
-        until total weight >= needed_weight.  A weight sum capped at 255
-        like the reference (uint8 accumulation with saturation at >255
-        handled by int here)."""
-        # semantics mirror the reference exactly: the used[] flags feed ONLY
-        # check_all_signatures_used (txBAD_AUTH_EXTRA) — a signature verified
-        # for the tx-level check is counted again by per-op checks.  Within
-        # one call, signatures iterate outermost and a matched signer is
-        # retired, so each signer contributes at most once per call; weights
-        # saturate at 255 (ref SignatureChecker.cpp:31-120).
+        until total weight >= needed_weight.
+
+        Mirrors the reference's structure EXACTLY (SignatureChecker.cpp
+        :31-135): signers split by key type; pre-auth-tx keys tallied
+        first against the tx hash; then HASH_X, ED25519, SIGNED_PAYLOAD
+        groups each scanned signatures-outer/signers-inner with a matched
+        signer retired per signature.  The type-major order is
+        observable: it decides WHICH signatures get marked used
+        (txBAD_AUTH_EXTRA).  Callers pre-filter disabled master keys
+        (account_signers), matching the reference's caller-side gate.
+        Weights saturate at 255 (uint8)."""
         total = 0
         SK = T.SignerKeyType
-
-        # pre-auth-tx signers match the tx hash directly, no signature bytes
+        groups: dict = {}
         for skey, weight in signers:
-            if skey.type == SK.SIGNER_KEY_TYPE_PRE_AUTH_TX and \
-                    skey.value == self.tx_hash:
+            groups.setdefault(skey.type, []).append((skey, weight))
+
+        # pre-auth-tx signers match the tx hash directly, no signature
+        for skey, weight in groups.get(
+                SK.SIGNER_KEY_TYPE_PRE_AUTH_TX, ()):
+            if skey.value == self.tx_hash:
                 total += min(weight, 255)
                 if total >= needed_weight:
                     return True
 
-        remaining = [
-            (skey, weight) for skey, weight in signers
-            if skey.type != SK.SIGNER_KEY_TYPE_PRE_AUTH_TX and weight > 0
-        ]
         hints = self._hints
-        for i, ds in enumerate(self.signatures):
-            hint = hints[i]
-            for j, (skey, weight) in enumerate(remaining):
-                t = skey.type
-                if t == SK.SIGNER_KEY_TYPE_ED25519:
-                    pub = skey.value
-                    if hint != pub[-4:]:
+
+        def verify_all(group, match) -> bool:
+            nonlocal total
+            for i, ds in enumerate(self.signatures):
+                hint = hints[i]
+                for j, (skey, weight) in enumerate(group):
+                    if not match(ds, hint, skey):
                         continue
-                    if not self._verify(pub, ds.signature, self.tx_hash):
-                        continue
-                elif t == SK.SIGNER_KEY_TYPE_HASH_X:
-                    if hint != skey.value[-4:]:
-                        continue
-                    if hashlib.sha256(ds.signature).digest() != skey.value:
-                        continue
-                elif t == SK.SIGNER_KEY_TYPE_ED25519_SIGNED_PAYLOAD:
-                    sp = skey.value
-                    pub = sp.ed25519
-                    # hint = payload-hint XOR key-hint (protocol 19)
-                    ph = sp.payload[-4:].ljust(4, b"\x00")
-                    want = bytes(a ^ b for a, b in
-                                 zip(signature_hint(pub), ph))
-                    if hint != want:
-                        continue
-                    if not self._verify(pub, ds.signature, sp.payload):
-                        continue
-                else:
-                    continue
-                self.used[i] = True
-                total += min(weight, 255)
-                if total >= needed_weight:
-                    return True
-                remaining.pop(j)
-                break
+                    self.used[i] = True
+                    total += min(weight, 255)
+                    if total >= needed_weight:
+                        return True
+                    group.pop(j)
+                    break
+            return False
+
+        def match_hash_x(ds, hint, skey) -> bool:
+            return (hint == skey.value[-4:]
+                    and hashlib.sha256(ds.signature).digest()
+                    == skey.value)
+
+        def match_ed25519(ds, hint, skey) -> bool:
+            pub = skey.value
+            return (hint == pub[-4:]
+                    and self._verify(pub, ds.signature, self.tx_hash))
+
+        def match_payload(ds, hint, skey) -> bool:
+            sp = skey.value
+            pub = sp.ed25519
+            # hint = payload-hint XOR key-hint (protocol 19)
+            ph = sp.payload[-4:].ljust(4, b"\x00")
+            want = bytes(a ^ b for a, b in zip(pub[-4:], ph))
+            return (hint == want
+                    and self._verify(pub, ds.signature, sp.payload))
+
+        for key_type, match in (
+                (SK.SIGNER_KEY_TYPE_HASH_X, match_hash_x),
+                (SK.SIGNER_KEY_TYPE_ED25519, match_ed25519),
+                (SK.SIGNER_KEY_TYPE_ED25519_SIGNED_PAYLOAD,
+                 match_payload)):
+            group = groups.get(key_type)
+            if group and verify_all(group, match):
+                return True
         return False
 
     def check_all_signatures_used(self) -> bool:
@@ -102,15 +112,22 @@ class SignatureChecker:
 
 
 def account_signers(account_entry) -> List[Tuple[object, int]]:
-    """Master key + additional signers as (SignerKey, weight) pairs."""
+    """Master key + additional signers as (SignerKey, weight) pairs.
+
+    A DISABLED master key (thresholds[0] == 0) is omitted entirely,
+    mirroring the reference caller (TransactionFrame::checkSignature
+    :306-310) — a weight-0 master key must never consume its matching
+    signature, or txBAD_AUTH_EXTRA outcomes diverge.  Additional signers
+    with weight 0 cannot exist on-ledger (SetOptions weight 0 deletes)."""
     acc = account_entry
     out: List[Tuple[object, int]] = []
     mw = acc.thresholds[0]
-    out.append((
-        T.SignerKey.make(T.SignerKeyType.SIGNER_KEY_TYPE_ED25519,
-                         acc.accountID.value),
-        mw,
-    ))
+    if mw:
+        out.append((
+            T.SignerKey.make(T.SignerKeyType.SIGNER_KEY_TYPE_ED25519,
+                             acc.accountID.value),
+            mw,
+        ))
     for s in acc.signers:
         out.append((s.key, s.weight))
     return out

