@@ -38,11 +38,22 @@ def cmd_run(cfg: Config) -> int:
     import time
 
     try:
+        last_gc = time.monotonic()
         while True:
             if app.crank(block=False) == 0:
                 # idle: nap briefly, then poll sockets/timers again (the
                 # asio run-loop equivalent)
                 time.sleep(0.005)
+                # DEFERRED_GC residual: a node that is idle (not closing
+                # ledgers — out of sync, or serving HTTP only) must
+                # still collect now and then, else cyclic garbage grows
+                # unboundedly with automatic GC off
+                if cfg.DEFERRED_GC and \
+                        time.monotonic() - last_gc > 30.0:
+                    import gc
+
+                    gc.collect(1)
+                    last_gc = time.monotonic()
     except KeyboardInterrupt:
         app.graceful_stop()
     return 0
