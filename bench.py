@@ -138,6 +138,7 @@ def main() -> None:
 
     def run_closes(shape):
         times = []
+        phase_rows = []
         for _ in range(n_closes):
             if shape == "mixed":
                 envs = lg2.generate_mixed(close_txs, dex_percent=dex_pct)
@@ -150,13 +151,14 @@ def main() -> None:
             t0 = time.perf_counter()
             app.herder.manual_close()
             times.append((time.perf_counter() - t0) * 1000)
+            phase_rows.append(dict(app.ledger_manager.last_close_phases))
             # the upgraded maxTxSetSize must have let the WHOLE batch
             # close — a trimmed set would silently measure less
             assert app.herder.tx_queue.size() == 0, "close left txs"
-        return times
+        return times, phase_rows
 
-    pay_times = run_closes("pay")
-    close_times = run_closes("mixed")
+    pay_times, _pay_phases = run_closes("pay")
+    close_times, close_phases = run_closes("mixed")
     pay_p50 = statistics.median(pay_times) if pay_times else None
     close_p50 = statistics.median(close_times) if close_times else None
     import math
@@ -254,6 +256,19 @@ def main() -> None:
         "close_shape": f"mixed({dex_pct}% dex)",
         "ledger_close_p50_ms_payments": (round(pay_p50, 1)
                                          if pay_p50 is not None else None),
+        # per-phase close breakdown (median ms across the mixed closes):
+        # verify/fee/apply/bucket(spill_wait,bucket_hash)/hash/commit/gc —
+        # the async-merge-pipeline evidence future BENCH_r*.json track
+        "close_phase_ms": {
+            ph: round(statistics.median(
+                row.get(ph, 0.0) for row in close_phases), 2)
+            for ph in ("verify", "fee", "apply", "bucket", "spill_wait",
+                       "bucket_hash", "hash", "commit", "gc", "total")
+        } if close_phases else None,
+        "bucket_merge_stats": {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in
+            app.bucket_manager.bucket_list.stats.items()},
     }
     if best is not None:
         line["best_device_capture"] = best

@@ -19,13 +19,32 @@ def main() -> None:
     npz = sys.argv[1]
     os.environ.pop("JAX_PLATFORMS", None)
 
+    # persistent compile cache FIRST (before any jit): a second capture
+    # window must not pay the worst-case 26-minute device compile again
+    from stellar_core_tpu.utils.device import (
+        enable_compilation_cache, pad_signature_batch,
+    )
+
+    cache_dir = enable_compilation_cache()
+    if cache_dir:
+        print(f"[bench-device] jax compilation cache at {cache_dir}",
+              file=sys.stderr, flush=True)
+
     import jax
     import numpy as np
 
     dev = jax.devices()[0]
     data = np.load(npz)
     pk, sg, mg = data["pk"], data["sg"], data["mg"]
-    n = pk.shape[0]
+    # pad to a fixed batch bucket (repeat valid rows) so this capture and
+    # every future one present the SAME shape to the compiler
+    n_real = pk.shape[0]
+    n = pad_signature_batch(n_real)
+    if n != n_real:
+        idx = np.arange(n) % n_real
+        pk, sg, mg = pk[idx], sg[idx], mg[idx]
+        print(f"[bench-device] padded batch {n_real} -> {n}",
+              file=sys.stderr, flush=True)
 
     kernel_pref = os.environ.get("BENCH_KERNEL", "pallas")
     verify_batch = None

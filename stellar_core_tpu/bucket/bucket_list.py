@@ -44,7 +44,7 @@ def level_should_spill(ledger_seq: int, level: int) -> bool:
 class Bucket:
     """Immutable sorted run of (key, BucketEntry)."""
 
-    __slots__ = ("entries", "_hash", "_keys")
+    __slots__ = ("entries", "_hash", "_keys", "_stream", "_table")
 
     EMPTY_HASH = b"\x00" * 32
 
@@ -52,6 +52,8 @@ class Bucket:
         self.entries = tuple(entries)
         self._hash: Optional[bytes] = None
         self._keys: Optional[Tuple[bytes, ...]] = None
+        self._stream: Optional[bytes] = None
+        self._table = None
 
     @property
     def keys(self) -> Tuple[bytes, ...]:
@@ -63,14 +65,62 @@ class Bucket:
     def is_empty(self) -> bool:
         return not self.entries
 
+    def _encoded(self) -> bytes:
+        """Canonical XDR stream, encoded once per bucket — serialize()
+        and merge_table() share it.  hash() deliberately does NOT cache
+        the stream: it hashes incrementally, so hash-only buckets (most
+        of every close) never pin a second byte-for-byte copy of their
+        entries."""
+        if self._stream is None:
+            self._stream = b"".join(
+                T.BucketEntry.encode(e) for _, e in self.entries)
+        return self._stream
+
     def hash(self) -> bytes:
         if not self.entries:
             return self.EMPTY_HASH
         if self._hash is None:
-            h = sha256(
-                b"".join(T.BucketEntry.encode(e) for _, e in self.entries))
-            self._hash = h
+            if self._stream is not None:
+                self._hash = sha256(self._stream)
+            else:
+                import hashlib
+
+                h = hashlib.sha256()
+                for _, e in self.entries:
+                    h.update(T.BucketEntry.encode(e))
+                self._hash = h.digest()
         return self._hash
+
+    def merge_table(self):
+        """(stream, eoff, elen, keys, koff, klen, types) for the native
+        streaming-merge kernel (same shape DiskBucket.merge_table
+        returns), cached on the bucket."""
+        if self._table is None:
+            import numpy as np
+
+            n = len(self.entries)
+            elen = np.zeros(n, np.int32)
+            types = np.zeros(n, np.int32)
+            parts: List[bytes] = []
+            for i, (_, e) in enumerate(self.entries):
+                p = T.BucketEntry.encode(e)
+                parts.append(p)
+                elen[i] = len(p)
+                types[i] = e.type
+            eoff = np.zeros(n, np.int64)
+            if n > 1:
+                np.cumsum(elen[:-1], out=eoff[1:])
+            if self._stream is None:
+                self._stream = b"".join(parts)
+            klen = np.zeros(n, np.int32)
+            for i, k in enumerate(self.keys):
+                klen[i] = len(k)
+            koff = np.zeros(n, np.int64)
+            if n > 1:
+                np.cumsum(klen[:-1], out=koff[1:])
+            self._table = (self._stream, eoff, elen, b"".join(self.keys),
+                           koff, klen, types)
+        return self._table
 
     @classmethod
     def fresh(cls, changes: Iterable[Tuple[bytes, Optional[object], bool]],
@@ -96,7 +146,7 @@ class Bucket:
     def serialize(self) -> bytes:
         """Canonical XDR stream of BucketEntry (the on-disk/archive file
         format, ref BucketOutputIterator)."""
-        return b"".join(T.BucketEntry.encode(e) for _, e in self.entries)
+        return self._encoded()
 
     @classmethod
     def deserialize(cls, data: bytes) -> "Bucket":
@@ -243,21 +293,30 @@ def _merge_entry(new, old):
     return new
 
 
-def merge_buckets(newer, older, disk_dir: Optional[str] = None):
+def merge_buckets(newer, older, disk_dir: Optional[str] = None,
+                  protect=None):
     """Tier-dispatching merge: when ``disk_dir`` is set the result is a
     DiskBucket built by a streaming merge (bounded memory); otherwise the
     in-memory merge.  Mixed-tier inputs stream through iter_entries either
     way; collision rules are the shared _merge_entry, so both tiers are
-    bitwise identical."""
-    from .disk_bucket import DiskBucket, merge_stream
+    bitwise identical.  ``protect(hash_hex)`` fires before a disk result
+    becomes visible (GC registration for background workers)."""
+    from .disk_bucket import DiskBucket, merge_disk_native, merge_stream
 
     if disk_dir is not None:
         if older.is_empty() and isinstance(newer, DiskBucket):
             return newer
         if newer.is_empty() and isinstance(older, DiskBucket):
             return older
+        # the deep-level hot path: one GIL-free native call does the
+        # whole merge (compare/copy/write/hash); the Python streaming
+        # merge below is the differential oracle + no-toolchain fallback
+        out = merge_disk_native(disk_dir, newer, older, protect=protect)
+        if out is not None:
+            return out
         return merge_stream(disk_dir, newer.iter_entries(),
-                            older.iter_entries(), _merge_entry)
+                            older.iter_entries(), _merge_entry,
+                            protect=protect)
     if isinstance(newer, DiskBucket) or isinstance(older, DiskBucket):
         # pulling a disk bucket back to memory happens only in small/test
         # configurations; keep semantics identical
@@ -298,15 +357,44 @@ class BucketList:
         # level's next spill-merge inputs are fully determined at its
         # PREVIOUS spill (snap and next.curr only change then), so the
         # merge runs on a worker thread during the half-capacity window
-        # between spills and is resolved at spill time.  Unlike the
+        # between spills and is resolved at spill time.  Every spill
+        # stages its successor — including the every-4th "coincident"
+        # spill where level+1 spills at the same seq: the cascade
+        # (deepest-first) empties next.curr before this level's snap
+        # arrives, so the staged partner is predicted EMPTY and the
+        # staged work is re-tiering snap alone (curr_ref None below).
+        # A close therefore only ever blocks on *this level's* future;
+        # it never re-runs a merge inline in steady state.  Unlike the
         # reference — whose in-flight merges commit one spill late and
         # therefore shape the canonical hash schedule — results here are
         # bitwise identical to the synchronous merge, so the hash chain
         # does not depend on whether (or when) backgrounding happened:
         # restart-mid-merge simply falls back to the synchronous path.
         self.executor = executor
-        # level -> (snap_ref, curr_ref, future)
-        self._futures: Dict[int, Tuple[Bucket, Bucket, object]] = {}
+        # level -> (snap_ref, curr_ref_or_None, future); curr_ref None
+        # means "staged against a predicted-empty curr"
+        self._futures: Dict[int, Tuple[Bucket, Optional[Bucket],
+                                       object]] = {}
+        # hex hashes of background-merge output files not yet adopted:
+        # workers register BEFORE renaming the file into the store, the
+        # main thread deregisters at adoption (result then in the live
+        # set) or when a mismatched staged future completes — so there is
+        # no instant at which a GC pass can see an unprotected,
+        # not-yet-live merge output
+        import threading as _threading
+
+        self._bg_lock = _threading.Lock()
+        self._bg_outputs: set = set()
+        # merge-pipeline observability (surfaced via /metrics and bench):
+        # sync_fallback_merges MUST stay 0 in steady state — it counts
+        # closes that had to run a non-trivial merge inline
+        self.stats: Dict[str, float] = {
+            "staged_merges": 0,
+            "resolved_merges": 0,
+            "sync_fallback_merges": 0,
+            "spill_wait_s": 0.0,
+            "hash_s": 0.0,
+        }
 
     def hash(self) -> bytes:
         """Cumulative commitment: sha256 over all level hashes
@@ -333,37 +421,83 @@ class BucketList:
         self.levels[0].curr = Bucket.merge(fresh, self.levels[0].curr)
         if self.executor is not None:
             for level in spilled:
-                # this level's next spill: if level+1 spills at the same
-                # seq (every 4th time — half(L+1) = 4*half(L)), the
-                # cascade empties next.curr first and the staged merge
-                # would be discarded by the identity check; don't stage
-                # doomed work
-                nxt_spill = ledger_seq + level_half(level)
-                if level_should_spill(nxt_spill, level + 1):
-                    continue
-                snap = self.levels[level].snap
-                curr = self.levels[level + 1].curr
-                if not snap.is_empty() and not curr.is_empty():
-                    self._futures[level] = (
-                        snap, curr,
-                        self.executor.submit(self._bg_merge, level,
-                                             snap, curr))
-        return self.hash()
+                self._stage_next_merge(level, ledger_seq)
+        import time as _time
+
+        t0 = _time.perf_counter()
+        out = self.hash()
+        self.stats["hash_s"] += _time.perf_counter() - t0
+        return out
+
+    def _stage_next_merge(self, level: int, ledger_seq: int) -> None:
+        """Stage this level's NEXT spill merge now (FutureBucket promise
+        chain): between spills of `level`, its snap is frozen and
+        next.curr only changes at `level`'s own spills, so the inputs are
+        exactly knowable.  The one wrinkle is the every-4th spill where
+        level+1 spills at the same future seq — the deepest-first cascade
+        will have emptied next.curr by then, so the right staged work is
+        re-tiering snap against an EMPTY partner (curr_ref None)."""
+        snap = self.levels[level].snap
+        nxt_spill = ledger_seq + level_half(level)
+        if level_should_spill(nxt_spill, level + 1):
+            curr: Optional[Bucket] = None
+            fut = self.executor.submit(self._bg_merge, level, snap,
+                                       Bucket())
+        else:
+            curr = self.levels[level + 1].curr
+            fut = self.executor.submit(self._bg_merge, level, snap, curr)
+        self._futures[level] = (snap, curr, fut)
+        self.stats["staged_merges"] += 1
 
     def _resolve_merge(self, level: int, snap: Bucket,
                        curr: Bucket) -> Bucket:
-        """Use the background merge started at this level's previous
+        """Adopt the background merge staged at this level's previous
         spill when its captured inputs are still the live ones; fall back
-        to a synchronous merge otherwise (first spill after construction
-        or restart, or a coincident deeper spill that replaced
-        next.curr — every 4th spill, where the fallback is a cheap merge
-        with an empty bucket)."""
+        to a synchronous merge otherwise.  In steady state the fallback
+        never fires (every spill stages its successor, coincident spills
+        included) — only a first-spill-after-restore or executor-less
+        list merges inline, and only non-trivial inline merges count as
+        sync fallbacks."""
         staged = self._futures.pop(level, None)
         if staged is not None:
             snap_ref, curr_ref, fut = staged
-            if snap_ref is snap and curr_ref is curr:
-                return fut.result()
+            ok = snap_ref is snap and (
+                curr_ref is curr if curr_ref is not None
+                else curr.is_empty())
+            if ok:
+                import time as _time
+
+                t0 = _time.perf_counter()
+                out = fut.result()
+                self.stats["spill_wait_s"] += _time.perf_counter() - t0
+                self.stats["resolved_merges"] += 1
+                self._unprotect(out)
+                return out
+            # mismatched staged work: release its output to GC once the
+            # worker is done with it (may still be running)
+            fut.add_done_callback(self._unprotect_future)
+        if self.executor is not None and \
+                not (snap.is_empty() and curr.is_empty()):
+            self.stats["sync_fallback_merges"] += 1
         return merge_buckets(snap, curr, self._merge_dir(level + 1))
+
+    def _protect_bg_output(self, hash_hex: str) -> None:
+        with self._bg_lock:
+            self._bg_outputs.add(hash_hex)
+
+    def _unprotect(self, bucket) -> None:
+        try:
+            hh = bucket.hash().hex()
+        except Exception:
+            return
+        with self._bg_lock:
+            self._bg_outputs.discard(hh)
+
+    def _unprotect_future(self, fut) -> None:
+        try:
+            self._unprotect(fut.result())
+        except Exception:
+            pass
 
     def _merge_dir(self, target_level: int) -> Optional[str]:
         """Directory for the merge result's tier (None = in-memory)."""
@@ -372,9 +506,19 @@ class BucketList:
         return None
 
     def _bg_merge(self, level: int, newer, older):
-        out = merge_buckets(newer, older, self._merge_dir(level + 1))
+        out = merge_buckets(newer, older, self._merge_dir(level + 1),
+                            protect=self._protect_bg_output)
         out.hash()  # pre-hash too: off the close critical path
         return out
+
+    def pending_merge_hashes(self) -> set:
+        """Hex hashes of background merge outputs written to the store
+        but not yet adopted — the bucket-store GC must not delete these
+        (registered by the worker BEFORE the file's rename, removed at
+        adoption, so no scan can catch an unprotected window; the spill
+        that adopts them may be many closes away)."""
+        with self._bg_lock:
+            return set(self._bg_outputs)
 
     # -- state access (catchup / BucketListDB-style lookups) ----------------
 
@@ -513,6 +657,11 @@ class BucketManager:
 
             os.makedirs(bucket_dir, exist_ok=True)
         self._saved: set = set()
+        # two-pass GC tombstones: a file is only deleted after TWO
+        # consecutive passes see it unreferenced, so a background merge
+        # renaming its output between the dir scan and the futures check
+        # can never lose the file it just wrote
+        self._gc_candidates: set = set()
 
     def add_batch(self, ledger_seq: int, changes) -> bytes:
         h = self.bucket_list.add_batch(ledger_seq, changes)
@@ -563,7 +712,10 @@ class BucketManager:
 
     def gc_unreferenced(self) -> None:
         """Delete bucket files the current (durably committed) bucket list
-        no longer references (ref forgetUnreferencedBuckets)."""
+        no longer references (ref forgetUnreferencedBuckets).  Completed
+        background-merge outputs awaiting adoption are protected, and
+        deletion is two-pass (see _gc_candidates) so an in-flight worker
+        renaming its output concurrently can never race a delete."""
         import os
 
         if self.bucket_dir is None:
@@ -571,6 +723,7 @@ class BucketManager:
         live = {b.hash().hex()
                 for lv in self.bucket_list.levels
                 for b in (lv.curr, lv.snap)}
+        live |= self.bucket_list.pending_merge_hashes()
         # scan the directory (not just _saved): background merges write
         # content-addressed files that may never be adopted (discarded
         # futures, restarts) and would otherwise leak forever
@@ -578,13 +731,56 @@ class BucketManager:
             names = os.listdir(self.bucket_dir)
         except OSError:
             names = []
-        for name in names:
-            if not (name.startswith("bucket-") and name.endswith(".xdr")):
-                continue
+        xdr_names = {n for n in names
+                     if n.startswith("bucket-") and n.endswith(".xdr")}
+        candidates = set()
+        for name in xdr_names:
             hh = name[len("bucket-"):-len(".xdr")]
             if hh in live:
                 continue
-            self._saved.discard(hh)
+            candidates.add(name)
+        # orphan sidecars (stream already collected earlier)
+        for name in names:
+            if name.endswith(".xdr.idx") and name[:-4] not in xdr_names:
+                candidates.add(name)
+        # temp files abandoned by crashed/killed processes: every writer
+        # embeds its pid (.tmp-<pid>-..., .merge-<pid>-....tmp,
+        # ....idx.<pid>.tmp) — reap only when that pid is gone, so an
+        # in-flight worker of a live process is never raced
+        self._reap_dead_tmp(names)
+        for name in candidates & self._gc_candidates:
+            if name.endswith(".xdr"):
+                self._saved.discard(name[len("bucket-"):-len(".xdr")])
+            for victim in (name, name + ".idx"):
+                try:
+                    os.remove(os.path.join(self.bucket_dir, victim))
+                except OSError:
+                    pass
+        self._gc_candidates = candidates - self._gc_candidates
+
+    @staticmethod
+    def _tmp_owner_pid(name: str):
+        import re
+
+        m = (re.match(r"\.tmp-(\d+)-", name)
+             or re.match(r"\.merge-(\d+)-.*\.tmp$", name)
+             or re.search(r"\.idx\.(\d+)\.tmp$", name))
+        return int(m.group(1)) if m else None
+
+    def _reap_dead_tmp(self, names) -> None:
+        import os
+
+        for name in names:
+            pid = self._tmp_owner_pid(name)
+            if pid is None or pid == os.getpid():
+                continue
+            try:
+                os.kill(pid, 0)
+                continue  # owner still alive: its write may be in flight
+            except ProcessLookupError:
+                pass
+            except OSError:
+                continue  # exists but not ours to signal: leave it
             try:
                 os.remove(os.path.join(self.bucket_dir, name))
             except OSError:

@@ -33,6 +33,11 @@ BET = T.BucketEntryType
 PAGE = 64  # entries per index page
 _READ_CHUNK = 1 << 20
 
+# sidecar entry-table files (<bucket>.xdr.idx): per-entry offsets, types
+# and key bytes persisted next to the stream so deep-level merges can run
+# entirely inside the native GIL-free kernel without re-parsing XDR
+_IDX_MAGIC = b"BKIDX01\n"
+
 
 def entry_key_bytes(e) -> bytes:
     from ..ledger.ledger_txn import entry_to_key, key_bytes
@@ -135,15 +140,27 @@ class DiskBucket:
 
     @classmethod
     def from_entries(cls, directory: str,
-                     entries: Iterable[Tuple[bytes, object]]
-                     ) -> "DiskBucket":
+                     entries: Iterable[Tuple[bytes, object]],
+                     protect=None) -> "DiskBucket":
         """Stream (key, entry) pairs (already sorted, collisions resolved)
-        to a content-addressed file <dir>/bucket-<hash>.xdr."""
+        to a content-addressed file <dir>/bucket-<hash>.xdr, recording the
+        per-entry sidecar table alongside so later merges over this bucket
+        can run in the native kernel without re-parsing the stream.
+        ``protect(hash_hex)``, when given, is invoked BEFORE the output
+        becomes visible under its content-addressed name — background
+        workers use it to register the file against store GC."""
+        from array import array
+
         os.makedirs(directory, exist_ok=True)
         tmp = os.path.join(directory, f".tmp-{os.getpid()}-{id(entries)}")
         h = hashlib.sha256()
         page_keys: List[bytes] = []
         page_offs: List[int] = []
+        eoff = array("q")
+        elen = array("i")
+        types = array("i")
+        klen = array("i")
+        key_parts: List[bytes] = []
         count = 0
         off = 0
         with open(tmp, "wb") as f:
@@ -154,6 +171,11 @@ class DiskBucket:
                     page_offs.append(off)
                 f.write(data)
                 h.update(data)
+                eoff.append(off)
+                elen.append(len(data))
+                types.append(e.type)
+                klen.append(len(kb))
+                key_parts.append(kb)
                 off += len(data)
                 count += 1
         if count == 0:
@@ -161,56 +183,313 @@ class DiskBucket:
             return cls("", 0, b"\x00" * 32, [], [], 0)
         digest = h.digest()
         path = os.path.join(directory, f"bucket-{digest.hex()}.xdr")
+        if protect is not None:
+            protect(digest.hex())
         os.replace(tmp, path)
+        import numpy as np
+
+        klen_np = np.frombuffer(klen, dtype=np.int32)
+        koff = np.zeros(count, np.int64)
+        np.cumsum(klen_np[:-1], out=koff[1:])
+        _write_sidecar(path, np.frombuffer(eoff, dtype=np.int64),
+                       np.frombuffer(elen, dtype=np.int32),
+                       np.frombuffer(types, dtype=np.int32),
+                       koff, klen_np, b"".join(key_parts))
         return cls(path, count, digest, page_keys, page_offs, off)
 
     @classmethod
     def open(cls, path: str,
              expected_hash: Optional[bytes] = None) -> "DiskBucket":
         """Index an existing bucket file (restore/catchup), verifying the
-        streamed hash when given."""
+        streamed hash when given.  A valid sidecar table skips the XDR
+        re-parse (the hash is still recomputed from the raw bytes); a
+        missing/stale sidecar triggers a full scan that rebuilds it."""
         h = hashlib.sha256()
-        page_keys: List[bytes] = []
-        page_offs: List[int] = []
-        count = 0
-        file_off = 0  # absolute offset of buf[0]
+        size = 0
         with open(path, "rb") as f:
-            buf = b""
-            pos = 0
             while True:
                 chunk = f.read(_READ_CHUNK)
-                if chunk:
-                    h.update(chunk)
-                file_off += pos
-                buf = buf[pos:] + chunk
-                pos = 0
-                r = Reader(buf)
-                while True:
-                    mark = r.pos
-                    try:
-                        e = T.BucketEntry.unpack(r)
-                    except Exception:
-                        pos = mark
-                        break
-                    if count % PAGE == 0:
-                        page_keys.append(entry_key_bytes(e))
-                        page_offs.append(file_off + mark)
-                    count += 1
-                    pos = r.pos
                 if not chunk:
-                    if pos < len(buf):
-                        raise RuntimeError(
-                            f"trailing bytes in bucket file {path}")
                     break
-        size = file_off + pos
-        digest = h.digest() if count else b"\x00" * 32
-        if expected_hash is not None and count and digest != expected_hash:
+                h.update(chunk)
+                size += len(chunk)
+        digest = h.digest() if size else b"\x00" * 32
+        if expected_hash is not None and size and digest != expected_hash:
             raise RuntimeError(f"bucket hash mismatch for {path}")
+        if size == 0:
+            return cls("", 0, b"\x00" * 32, [], [], 0)
+        t = _read_sidecar(path, expected_size=size)
+        if t is None:
+            t = _scan_tables(path)
+            _write_sidecar(path, *t)
+        eoff, elen, types, koff, klen, keys = t
+        count = len(eoff)
+        page_keys = [bytes(keys[koff[i]:koff[i] + klen[i]])
+                     for i in range(0, count, PAGE)]
+        page_offs = [int(o) for o in eoff[::PAGE]]
         return cls(path, count, digest, page_keys, page_offs, size)
+
+    def merge_table(self):
+        """(stream, eoff, elen, keys, koff, klen, types) for the native
+        merge kernel; None when unavailable.  The stream is a read-only
+        memmap so GB-scale merges keep bounded resident memory."""
+        import numpy as np
+
+        if self.count == 0:
+            return _empty_table()
+        t = _read_sidecar(self.path, expected_size=self.size_bytes)
+        if t is None:
+            try:
+                t = _scan_tables(self.path)
+            except Exception:
+                return None
+            _write_sidecar(self.path, *t)
+        eoff, elen, types, koff, klen, keys = t
+        if len(eoff) != self.count:
+            return None  # stale sidecar: fall back to the Python tier
+        stream = np.memmap(self.path, dtype=np.uint8, mode="r")
+        return (stream, eoff, elen, keys, koff, klen, types)
+
+
+def _sidecar_path(path: str) -> str:
+    return path + ".idx"
+
+
+def _write_sidecar(path: str, eoff, elen, types, koff, klen,
+                   keys: bytes) -> None:
+    """Persist the per-entry table next to the bucket stream (atomic)."""
+    import numpy as np
+
+    sp = _sidecar_path(path)
+    tmp = f"{sp}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(_IDX_MAGIC)
+            np.array([len(eoff), len(keys)], np.int64).tofile(f)
+            np.ascontiguousarray(eoff, np.int64).tofile(f)
+            np.ascontiguousarray(elen, np.int32).tofile(f)
+            np.ascontiguousarray(types, np.int32).tofile(f)
+            np.ascontiguousarray(koff, np.int64).tofile(f)
+            np.ascontiguousarray(klen, np.int32).tofile(f)
+            f.write(keys)
+        os.replace(tmp, sp)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def _read_sidecar(path: str, expected_size: Optional[int] = None):
+    """Load the sidecar table; None when missing or inconsistent with the
+    stream (e.g. written by an older version, torn, or the stream file
+    was replaced)."""
+    import numpy as np
+
+    try:
+        with open(_sidecar_path(path), "rb") as f:
+            data = f.read()
+    except OSError:
+        return None
+    if not data.startswith(_IDX_MAGIC):
+        return None
+    try:
+        head = np.frombuffer(data, np.int64, count=2,
+                             offset=len(_IDX_MAGIC))
+        n, keys_bytes = int(head[0]), int(head[1])
+        off = len(_IDX_MAGIC) + 16
+        eoff = np.frombuffer(data, np.int64, count=n, offset=off)
+        off += 8 * n
+        elen = np.frombuffer(data, np.int32, count=n, offset=off)
+        off += 4 * n
+        types = np.frombuffer(data, np.int32, count=n, offset=off)
+        off += 4 * n
+        koff = np.frombuffer(data, np.int64, count=n, offset=off)
+        off += 8 * n
+        klen = np.frombuffer(data, np.int32, count=n, offset=off)
+        off += 4 * n
+        keys = data[off:off + keys_bytes]
+        if len(keys) != keys_bytes:
+            return None
+    except (ValueError, IndexError):
+        return None
+    if n and expected_size is not None and \
+            int(eoff[-1]) + int(elen[-1]) != expected_size:
+        return None  # sidecar does not describe this stream
+    return eoff, elen, types, koff, klen, keys
+
+
+def _scan_tables(path: str):
+    """Parse a bucket stream into the full per-entry table (the slow
+    Python path — only for legacy files with no sidecar)."""
+    import numpy as np
+    from array import array
+
+    eoff = array("q")
+    elen = array("i")
+    types = array("i")
+    klen = array("i")
+    key_parts: List[bytes] = []
+    file_off = 0
+    with open(path, "rb") as f:
+        buf = b""
+        pos = 0
+        while True:
+            chunk = f.read(_READ_CHUNK)
+            file_off += pos
+            buf = buf[pos:] + chunk
+            pos = 0
+            r = Reader(buf)
+            while True:
+                mark = r.pos
+                try:
+                    e = T.BucketEntry.unpack(r)
+                except Exception:
+                    pos = mark
+                    break
+                kb = entry_key_bytes(e)
+                eoff.append(file_off + mark)
+                elen.append(r.pos - mark)
+                types.append(e.type)
+                klen.append(len(kb))
+                key_parts.append(kb)
+                pos = r.pos
+            if not chunk:
+                if pos < len(buf):
+                    raise RuntimeError(
+                        f"trailing bytes in bucket file {path}")
+                break
+    n = len(eoff)
+    klen_np = np.frombuffer(klen, dtype=np.int32) if n else \
+        np.zeros(0, np.int32)
+    koff = np.zeros(n, np.int64)
+    if n > 1:
+        np.cumsum(klen_np[:-1], out=koff[1:])
+    eoff_np = np.frombuffer(eoff, dtype=np.int64) if n else \
+        np.zeros(0, np.int64)
+    elen_np = np.frombuffer(elen, dtype=np.int32) if n else \
+        np.zeros(0, np.int32)
+    types_np = np.frombuffer(types, dtype=np.int32) if n else \
+        np.zeros(0, np.int32)
+    return eoff_np, elen_np, types_np, koff, klen_np, b"".join(key_parts)
+
+
+def _empty_table():
+    import numpy as np
+
+    z64 = np.zeros(0, np.int64)
+    z32 = np.zeros(0, np.int32)
+    return (np.zeros(0, np.uint8), z64, z32, b"", z64, z32, z32)
+
+
+def merge_disk_native(directory: str, newer, older,
+                      protect=None) -> Optional["DiskBucket"]:
+    """Run a disk-tier merge entirely inside the native kernel: key
+    compares, collision rules, entry copy, output stream write and the
+    bucket sha256 all happen in one GIL-free C call, so a background
+    merge truly overlaps the interpreter.  Returns None when the native
+    tier or the entry tables are unavailable (callers fall back to the
+    Python streaming merge)."""
+    import ctypes
+
+    import numpy as np
+
+    from ..native import get_lib
+
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "bucket_merge_stream"):
+        return None
+    tn = _table_of(newer)
+    to = _table_of(older)
+    if tn is None or to is None:
+        return None
+    (ns, ne, nl, nk, nko, nkl, nt) = tn
+    (os_, oe, ol, ok_, oko, okl, ot) = to
+    n_new, n_old = len(ne), len(oe)
+    cap = n_new + n_old
+    out_eoff = np.zeros(cap, np.int64)
+    out_elen = np.zeros(cap, np.int32)
+    out_types = np.zeros(cap, np.int32)
+    out_keys = np.zeros(len(nk) + len(ok_), np.uint8)
+    out_koff = np.zeros(cap, np.int64)
+    out_klen = np.zeros(cap, np.int32)
+    out_hash = np.zeros(32, np.uint8)
+    out_bytes = np.zeros(1, np.int64)
+
+    def p64(a):
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+    def p32(a):
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+    def pu8(a):
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+    def pstream(s):
+        if isinstance(s, bytes):
+            return s
+        return s.ctypes.data_as(ctypes.c_char_p)
+
+    os.makedirs(directory, exist_ok=True)
+    tmp = os.path.join(directory,
+                       f".merge-{os.getpid()}-{id(out_eoff)}.tmp")
+    n = lib.bucket_merge_stream(
+        pstream(ns), p64(np.ascontiguousarray(ne, np.int64)),
+        p32(np.ascontiguousarray(nl, np.int32)), nk,
+        p64(np.ascontiguousarray(nko, np.int64)),
+        p32(np.ascontiguousarray(nkl, np.int32)),
+        p32(np.ascontiguousarray(nt, np.int32)), n_new,
+        pstream(os_), p64(np.ascontiguousarray(oe, np.int64)),
+        p32(np.ascontiguousarray(ol, np.int32)), ok_,
+        p64(np.ascontiguousarray(oko, np.int64)),
+        p32(np.ascontiguousarray(okl, np.int32)),
+        p32(np.ascontiguousarray(ot, np.int32)), n_old,
+        tmp.encode(), p64(out_eoff), p32(out_elen), p32(out_types),
+        pu8(out_keys), p64(out_koff), p32(out_klen),
+        pu8(out_hash), p64(out_bytes))
+    if n < 0:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    if n == 0:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return DiskBucket("", 0, b"\x00" * 32, [], [], 0)
+    digest = bytes(out_hash.tobytes())
+    path = os.path.join(directory, f"bucket-{digest.hex()}.xdr")
+    if protect is not None:
+        # register with the store GC BEFORE the file becomes visible
+        # under its content-addressed name: from that instant until the
+        # spill adopts the result there must be no unprotected window
+        protect(digest.hex())
+    os.replace(tmp, path)
+    keys_blob = out_keys.tobytes()
+    _write_sidecar(path, out_eoff[:n], out_elen[:n], out_types[:n],
+                   out_koff[:n], out_klen[:n],
+                   keys_blob[:int(out_koff[n - 1]) + int(out_klen[n - 1])])
+    page_keys = [keys_blob[int(out_koff[i]):
+                           int(out_koff[i]) + int(out_klen[i])]
+                 for i in range(0, n, PAGE)]
+    page_offs = [int(o) for o in out_eoff[:n:PAGE]]
+    return DiskBucket(path, int(n), digest, page_keys, page_offs,
+                      int(out_bytes[0]))
+
+
+def _table_of(bucket):
+    """Entry table for either tier (DiskBucket sidecar / in-memory
+    serialized stream); None when the bucket cannot provide one."""
+    table = getattr(bucket, "merge_table", None)
+    if table is None:
+        return None
+    return table()
 
 
 def merge_stream(directory: str, newer_iter, older_iter,
-                 merge_entry) -> "DiskBucket":
+                 merge_entry, protect=None) -> "DiskBucket":
     """Streaming shadow-merge of two sorted (key, entry) iterators into a
     new DiskBucket; ``merge_entry(new, old)`` resolves collisions (the
     in-memory tier's exact function, so results are bitwise identical)."""
@@ -240,4 +519,4 @@ def merge_stream(directory: str, newer_iter, older_iter,
             yield o
             o = next(oi, sentinel)
 
-    return DiskBucket.from_entries(directory, gen())
+    return DiskBucket.from_entries(directory, gen(), protect=protect)

@@ -14,11 +14,18 @@ from __future__ import annotations
 import random
 from dataclasses import dataclass
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    _HAVE_OPENSSL = True
+except ImportError:
+    # toolchain-less hosts: fall back to the pure-Python executable spec
+    # (ed25519_ref) for sign/verify/keygen.  Slower (~ms per op) but
+    # bit-identical semantics, so nodes and tests still run.
+    _HAVE_OPENSSL = False
 
 from .sha import sha256
 
@@ -44,6 +51,11 @@ def clear_verify_cache() -> None:
     _cache_hits = 0
     _cache_misses = 0
 
+
+# pure-Python tier only: seed -> derived public key (keygen is a full
+# base-point scalar mult; tests re-derive the same deterministic seeds
+# constantly)
+_pub_cache: dict[bytes, bytes] = {}
 
 _SMALL_ORDER: frozenset | None = None
 
@@ -72,6 +84,10 @@ def raw_verify(pubkey: bytes, signature: bytes, message: bytes) -> bool:
     so = _small_order_encodings()
     if pubkey in so or signature[:32] in so:
         return False
+    if not _HAVE_OPENSSL:
+        from . import ed25519_ref
+
+        return ed25519_ref.verify(pubkey, signature, message)
     try:
         Ed25519PublicKey.from_public_bytes(pubkey).verify(signature, message)
         return True
@@ -100,6 +116,10 @@ def verify_sig(pubkey: bytes, signature: bytes, message: bytes) -> bool:
 
 
 def sign(seed: bytes, message: bytes) -> bytes:
+    if not _HAVE_OPENSSL:
+        from . import ed25519_ref
+
+        return ed25519_ref.sign(seed, message)
     return Ed25519PrivateKey.from_private_bytes(seed).sign(message)
 
 
@@ -134,8 +154,20 @@ class SecretKey:
         if len(seed) != 32:
             raise ValueError("seed must be 32 bytes")
         self._seed = seed
-        self._priv = Ed25519PrivateKey.from_private_bytes(seed)
-        self._pub = self._priv.public_key().public_bytes_raw()
+        if _HAVE_OPENSSL:
+            self._priv = Ed25519PrivateKey.from_private_bytes(seed)
+            self._pub = self._priv.public_key().public_bytes_raw()
+        else:
+            self._priv = None
+            pub = _pub_cache.get(seed)
+            if pub is None:
+                from . import ed25519_ref
+
+                pub = ed25519_ref.public_from_seed(seed)
+                if len(_pub_cache) >= _VERIFY_CACHE_SIZE:
+                    _pub_cache.clear()
+                _pub_cache[seed] = pub
+            self._pub = pub
 
     @classmethod
     def random(cls) -> "SecretKey":
@@ -156,6 +188,10 @@ class SecretKey:
         return PublicKey(self._pub)
 
     def sign(self, message: bytes) -> bytes:
+        if self._priv is None:
+            from . import ed25519_ref
+
+            return ed25519_ref.sign(self._seed, message)
         return self._priv.sign(message)
 
     def strkey_seed(self) -> str:

@@ -226,6 +226,65 @@ def hram(r_bytes: bytes, a_bytes: bytes, message: bytes) -> int:
     return int.from_bytes(hashlib.sha512(r_bytes + a_bytes + message).digest(), "little") % L
 
 
+_BASE_POWERS: list | None = None
+
+
+def _base_powers() -> list:
+    """[B*2^i] for i in 0..255 — keygen/sign do many [k]B multiplies; the
+    precomputed doubling chain halves their cost (built once, lazily)."""
+    global _BASE_POWERS
+    if _BASE_POWERS is None:
+        q = to_extended(B)
+        tbl = []
+        for _ in range(256):
+            tbl.append(q)
+            q = point_double(q)
+        _BASE_POWERS = tbl
+    return _BASE_POWERS
+
+
+def scalar_mult_base(k: int) -> tuple[int, int, int, int]:
+    """[k]B via the precomputed doubling chain."""
+    tbl = _base_powers()
+    acc = IDENT
+    i = 0
+    while k:
+        if k & 1:
+            acc = point_add(acc, tbl[i])
+        k >>= 1
+        i += 1
+    return acc
+
+
+def expand_seed(seed: bytes) -> tuple[int, bytes]:
+    """RFC 8032 key expansion: clamped scalar + the signing prefix."""
+    h = hashlib.sha512(seed).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a, h[32:]
+
+
+def public_from_seed(seed: bytes) -> bytes:
+    """Derive the 32-byte public key A = [a]B from a seed."""
+    a, _ = expand_seed(seed)
+    return encode_point(scalar_mult_base(a))
+
+
+def sign(seed: bytes, message: bytes) -> bytes:
+    """RFC 8032 detached signature (the fallback CPU tier's signer when
+    OpenSSL is unavailable; deterministic, so bit-identical across
+    backends)."""
+    a, prefix = expand_seed(seed)
+    a_bytes = encode_point(scalar_mult_base(a))
+    r = int.from_bytes(
+        hashlib.sha512(prefix + message).digest(), "little") % L
+    r_bytes = encode_point(scalar_mult_base(r))
+    k = hram(r_bytes, a_bytes, message)
+    s = (r + k * a) % L
+    return r_bytes + s.to_bytes(32, "little")
+
+
 def verify(pubkey: bytes, signature: bytes, message: bytes) -> bool:
     """libsodium crypto_sign_verify_detached semantics (see module doc)."""
     if len(pubkey) != 32 or len(signature) != 64:

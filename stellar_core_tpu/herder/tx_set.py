@@ -235,6 +235,8 @@ class TxSetFrame:
             else:
                 from ..ops.ed25519_kernel import verify_batch
 
+            from ..utils.device import pad_signature_batch
+
             n = len(triples)
             pk = np.frombuffer(
                 b"".join(t[0] for t in triples), np.uint8).reshape(n, 32)
@@ -243,7 +245,15 @@ class TxSetFrame:
                 np.uint8).reshape(n, 64)
             mg = np.frombuffer(
                 b"".join(t[2] for t in triples), np.uint8).reshape(n, 32)
-            ok = np.asarray(verify_batch(pk, sg, mg))
+            # pad to a fixed batch bucket (repeating real rows) so the
+            # device sees a small closed set of shapes — per-close batch
+            # sizes vary freely and would otherwise force a recompile
+            # every time a new size shows up
+            padded = pad_signature_batch(n)
+            if padded != n:
+                idx = np.arange(padded) % n
+                pk, sg, mg = pk[idx], sg[idx], mg[idx]
+            ok = np.asarray(verify_batch(pk, sg, mg))[:n]
             for t, v in zip(triples, ok):
                 verdicts[(t[0], t[1], t[2])] = bool(v)
         else:

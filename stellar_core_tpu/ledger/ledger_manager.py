@@ -33,6 +33,10 @@ class LedgerManager:
         self.root = LedgerTxnRoot(app.database)
         self._lcl_hash: Optional[bytes] = None
         self.metrics = app.metrics
+        # per-phase breakdown of the most recent close (ms), plus
+        # cumulative phase timers in the metrics registry — the
+        # observability the async merge pipeline is judged by
+        self.last_close_phases: dict = {}
 
     # -- genesis / load ----------------------------------------------------
 
@@ -119,6 +123,12 @@ class LedgerManager:
                                  threshold_seconds=2.0):
             self._close_ledger_inner(close_data)
 
+    def _phase(self, phases: dict, name: str, t0: float,
+               t1: float) -> None:
+        ms = (t1 - t0) * 1000.0
+        phases[name] = phases.get(name, 0.0) + ms
+        self.metrics.timer(f"ledger.close.phase.{name}").update(t1 - t0)
+
     def _close_ledger_inner(self, close_data: LedgerCloseData) -> None:
         prev_header = self.root.header()
         if close_data.ledger_seq != prev_header.ledgerSeq + 1:
@@ -129,6 +139,11 @@ class LedgerManager:
         if tx_set.previous_ledger_hash != self.last_closed_hash():
             raise RuntimeError("tx set prev hash mismatch")
         sv = close_data.close_value
+
+        from time import perf_counter
+
+        phases: dict = {}
+        t_close0 = perf_counter()
 
         with LedgerTxn(self.root) as ltx:
             # open the new ledger: bump seq, set close-time scpValue
@@ -150,23 +165,28 @@ class LedgerManager:
             self.root.prefetch(prefetch_keys)
 
             # phase 0: batched signature verification on device (P5)
+            t0 = perf_counter()
             verdicts = tx_set.prevalidate_signatures(
                 use_device=self.app.config.CRYPTO_BACKEND == "tpu")
             verify = tx_set.make_cached_verify(verdicts)
+            self._phase(phases, "verify", t0, perf_counter())
 
             # phase 1: fees + seqnums for every tx, in apply order
             # (ref processFeesSeqNums :1164)
             fee_changes: List[object] = []
             base_fee = prev_header.baseFee
+            t0 = perf_counter()
             with self.metrics.timer(
                     "ledger.transaction.fee").time_scope():
                 for frame in apply_order:
                     fee_changes.append(
                         frame.process_fee_seq_num(ltx, base_fee))
+            self._phase(phases, "fee", t0, perf_counter())
 
             # phase 2: apply transactions (ref applyTransactions :1297)
             tx_result_metas: List[object] = []
             result_pairs: List[object] = []
+            t0 = perf_counter()
             with self.metrics.timer(
                     "ledger.transaction.apply").time_scope():
                 for i, frame in enumerate(apply_order):
@@ -180,6 +200,7 @@ class LedgerManager:
                         result=pair,
                         feeProcessing=fee_changes[i],
                         txApplyProcessing=meta))
+            self._phase(phases, "apply", t0, perf_counter())
 
             # phase 3: upgrades — each validated against local policy
             # before applying; invalid remote upgrades are skipped, not
@@ -202,16 +223,37 @@ class LedgerManager:
                     upgrade=upgrade, changes=changes))
 
             # phase 4: seal the header
+            t0 = perf_counter()
             result_set = T.TransactionResultSet.make(results=result_pairs)
             tx_result_hash = xdr_sha256(T.TransactionResultSet, result_set)
             sealed = ltx.header()._replace(
                 txSetResultHash=tx_result_hash,
             )
             ltx.set_header(sealed)
+            self._phase(phases, "hash", t0, perf_counter())
 
-            # phase 5: bucket list — state commitment
+            # phase 5: bucket list — state commitment.  spill_wait /
+            # bucket-hash sub-phases come from the merge pipeline's own
+            # accounting (deltas over BucketList.stats)
+            bl = self.app.bucket_manager.bucket_list
+            stats0 = dict(bl.stats)
+            t0 = perf_counter()
             bucket_hash = self.app.bucket_manager.add_batch(
                 close_data.ledger_seq, self._collect_changes(ltx))
+            t1 = perf_counter()
+            self._phase(phases, "bucket", t0, t1)
+            phases["spill_wait"] = round(
+                (bl.stats["spill_wait_s"] - stats0["spill_wait_s"])
+                * 1000.0, 3)
+            phases["bucket_hash"] = round(
+                (bl.stats["hash_s"] - stats0["hash_s"]) * 1000.0, 3)
+            sync_fb = int(bl.stats["sync_fallback_merges"]
+                          - stats0["sync_fallback_merges"])
+            if sync_fb:
+                self.metrics.counter(
+                    "bucket.merge.sync-fallback").inc(sync_fb)
+
+            t0 = perf_counter()
             sealed = ltx.header()._replace(bucketListHash=bucket_hash)
             sealed = self._update_skip_list(sealed)
             ltx.set_header(sealed)
@@ -225,6 +267,7 @@ class LedgerManager:
         self._lcl_hash = xdr_sha256(T.LedgerHeader, new_header)
         self._store_lcl(new_header)
         self._store_bucket_state()
+        self._phase(phases, "commit", t0, perf_counter())
         self.metrics.counter("ledger.ledger.count").set_count(
             new_header.ledgerSeq)
         # history: queue + publish checkpoints (ref closeLedger :890-899 —
@@ -237,7 +280,14 @@ class LedgerManager:
         # meta stream for downstream consumers
         self.app.emit_ledger_close_meta(
             new_header, tx_set, tx_result_metas, upgrade_metas)
+        t0 = perf_counter()
         self._post_close_gc(new_header.ledgerSeq)
+        self._phase(phases, "gc", t0, perf_counter())
+        phases["total"] = round((perf_counter() - t_close0) * 1000.0, 3)
+        phases["sync_fallback_merges"] = sync_fb
+        self.last_close_phases = {
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in phases.items()}
 
     def _post_close_gc(self, seq: int) -> None:
         """DEFERRED_GC: young-gen collection after every close, full

@@ -273,7 +273,10 @@ class Config:
 
     @classmethod
     def from_toml(cls, path: str) -> "Config":
-        import tomllib
+        try:
+            import tomllib
+        except ImportError:  # Python < 3.11: the bundled subset parser
+            from ..utils import minitoml as tomllib
 
         with open(path, "rb") as f:
             data = tomllib.load(f)

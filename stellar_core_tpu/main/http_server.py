@@ -58,6 +58,15 @@ class CommandHandler:
         root = self.app.ledger_manager.root
         snap["ledger.prefetch.hit-rate"] = round(
             root.prefetch_hit_rate(), 4)
+        # the async merge pipeline's health at a glance: per-phase ms of
+        # the last close + cumulative staging counters (sync_fallback
+        # _merges must read 0 in steady state)
+        snap["ledger.close.phases"] = \
+            self.app.ledger_manager.last_close_phases
+        bl = self.app.bucket_manager.bucket_list
+        snap["bucket.merge.pipeline"] = {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in bl.stats.items()}
         return 200, {"metrics": snap}
 
     def peers(self, params):
@@ -166,6 +175,11 @@ class CommandHandler:
         if mode == "create":
             return submit(lg.create_account_envelopes(n_accounts),
                           "accounts exist after the next close")
+        if not lg.accounts:
+            # restarted node: the pool is a deterministic function of the
+            # account ordinal (ref LoadGenerator::findAccount), so probe
+            # the ledger for previously-created accounts before giving up
+            lg.restore_accounts()
         if not lg.accounts:
             return 400, {"error": "run mode=create (and close) first"}
         if mode == "pay":

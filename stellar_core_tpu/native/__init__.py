@@ -66,6 +66,23 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int32),
         ]
+        if hasattr(lib, "bucket_merge_stream"):
+            p64 = ctypes.POINTER(ctypes.c_int64)
+            p32 = ctypes.POINTER(ctypes.c_int32)
+            pu8 = ctypes.POINTER(ctypes.c_uint8)
+            lib.bucket_merge_stream.restype = ctypes.c_int64
+            lib.bucket_merge_stream.argtypes = [
+                ctypes.c_char_p, p64, p32,        # new stream/eoff/elen
+                ctypes.c_char_p, p64, p32, p32,   # new keys/koff/klen/types
+                ctypes.c_int64,                   # n_new
+                ctypes.c_char_p, p64, p32,        # old stream/eoff/elen
+                ctypes.c_char_p, p64, p32, p32,   # old keys/koff/klen/types
+                ctypes.c_int64,                   # n_old
+                ctypes.c_char_p,                  # out_path (NULL = no file)
+                p64, p32, p32,                    # out eoff/elen/types
+                pu8, p64, p32,                    # out keys/koff/klen
+                pu8, p64,                         # out_hash32, out_bytes
+            ]
         if not hasattr(lib, "quorum_enum_check"):
             # stale prebuilt .so (mtime newer than sources but missing
             # newer symbols): degrade to the Python tiers rather than

@@ -28,6 +28,37 @@ class LoadGenerator:
         self.accounts: List[SecretKey] = []
         self._seqs = {}
 
+    # -- deterministic account derivation -----------------------------------
+
+    @staticmethod
+    def account_key(i: int, prefix: bytes = b"loadgen") -> SecretKey:
+        """The i-th generator account key, derived purely from the index
+        (ref LoadGenerator::findAccount — accounts are a deterministic
+        function of their ordinal, so a restarted node can regenerate
+        load against accounts created before the restart without
+        re-creating them)."""
+        return SecretKey(sha256(prefix + b"-%d" % i))
+
+    def restore_accounts(self, prefix: bytes = b"loadgen",
+                         limit: int = 100_000) -> int:
+        """Rebuild the account pool after a process restart by probing the
+        ledger for consecutively-derived accounts until one is absent.
+        Returns how many accounts were recovered."""
+        from ..ledger.ledger_txn import entry_to_key, key_bytes
+
+        root = self.app.ledger_manager.root
+        found = []
+        for i in range(limit):
+            sk = self.account_key(i, prefix)
+            kb = key_bytes(entry_to_key(
+                U.make_account_entry(sk.public_key().raw, 0)))
+            if root.get(kb) is None:
+                break
+            found.append(sk)
+        self.accounts = found
+        self._seqs = {}
+        return len(found)
+
     # -- CREATE mode --------------------------------------------------------
 
     def create_accounts(self, n: int, balance: int = 10**9,
@@ -35,7 +66,7 @@ class LoadGenerator:
         """Seed n funded accounts directly into the ledger root (bulk;
         the per-tx path would be n CreateAccount ops)."""
         root = self.app.ledger_manager.root
-        new = [SecretKey(sha256(prefix + b"-%d" % i)) for i in range(n)]
+        new = [self.account_key(i, prefix) for i in range(n)]
         with LedgerTxn(root) as ltx:
             for sk in new:
                 ltx.put(U.make_account_entry(
@@ -245,7 +276,7 @@ class LoadGenerator:
         create_accounts() writer is for in-process perf rigs only and
         leaves the SQL tier ahead of the buckets)."""
         root = self.root_key()
-        new = [SecretKey(sha256(prefix + b"-%d" % i)) for i in range(n)]
+        new = [self.account_key(i, prefix) for i in range(n)]
         envs = []
         for i in range(0, len(new), batch):
             chunk = new[i:i + batch]

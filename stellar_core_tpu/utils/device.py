@@ -67,3 +67,52 @@ def _reset_for_tests() -> None:
     global _result
     with _lock:
         _result = None
+
+
+# -- compile-time hygiene (VERDICT r5 weak #1: 26-minute device compiles) ----
+
+def enable_compilation_cache(cache_dir: Optional[str] = None
+                             ) -> Optional[str]:
+    """Point JAX at a persistent compilation cache so a second capture
+    window (or a recompile after a tunnel drop) skips lowering+compile
+    entirely.  Returns the cache dir, or None when it could not be set
+    (old jax, read-only filesystem) — callers proceed uncached."""
+    cache_dir = (cache_dir
+                 or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+                 or os.path.join(os.path.expanduser("~"), ".cache",
+                                 "stellar_core_tpu", "jax_cache"))
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache even fast compiles: the failure mode being bounded is a
+        # 26-minute device compile, but re-warming hundreds of small
+        # programs through a flaky tunnel adds up too
+        try:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+        except Exception:
+            pass  # knob name varies across jax versions; best effort
+        return cache_dir
+    except Exception:
+        return None
+
+
+# Fixed signature-batch sizes: every device verify pads its batch up to
+# one of these, so admission traffic cannot present a new shape per close
+# and trigger a recompile mid-capture.  Shapes are MXU-friendly powers of
+# two; beyond the largest bucket, batches round up to its multiple.
+SIG_BATCH_BUCKETS = (256, 512, 1024, 2048, 4096, 8192, 16384, 32768,
+                     65536, 131072)
+
+
+def pad_signature_batch(n: int) -> int:
+    """Smallest allowed batch size >= n."""
+    if n <= 0:
+        return SIG_BATCH_BUCKETS[0]
+    for b in SIG_BATCH_BUCKETS:
+        if n <= b:
+            return b
+    top = SIG_BATCH_BUCKETS[-1]
+    return ((n + top - 1) // top) * top

@@ -23,7 +23,13 @@ class XdrError(Exception):
     pass
 
 
-MAX_DECODE_DEPTH = 200
+# Wire-facing decode depth bound.  Legitimate protocol structures nest
+# single digits deep (quorum sets are validity-bounded at 4); the guard
+# exists to turn adversarial nesting into XdrError.  It must trip well
+# before CPython's recursion limit does — each XDR level costs ~6
+# interpreter frames, so 100 levels stays comfortably inside the default
+# 1000-frame limit even under pytest's extra stack.
+MAX_DECODE_DEPTH = 100
 
 
 class Reader:

@@ -1,8 +1,12 @@
 #!/usr/bin/env python
-"""Bucket scale evidence (VERDICT r4 task 5 'done when'): a synthetic
-1M-entry ledger flows through the disk-tier BucketList and back out of a
-catchup-style streaming read with bounded RSS.  Writes
-BUCKET_SCALE_r05.json.
+"""Bucket scale evidence: a synthetic 1M-entry ledger flows through the
+disk-tier BucketList and back out of a catchup-style streaming read with
+bounded RSS.  Since r06 the run exercises the REAL close configuration:
+background merges on a worker pool (FutureBucket promise chain) with the
+native streaming merge kernel, so close_ms_max measures what a validator
+would stall, not the synchronous worst case.  Writes
+BUCKET_SCALE_r06.json including the merge-pipeline counters
+(sync_fallback_merges must be 0).
 
 Usage: python tools/bucket_scale_bench.py [n_entries] [per_close]
 """
@@ -32,8 +36,12 @@ def main():
     from stellar_core_tpu.ledger.ledger_txn import entry_to_key, key_bytes
     from stellar_core_tpu.transactions import utils as U
 
+    from concurrent.futures import ThreadPoolExecutor
+
     tmp = tempfile.mkdtemp(prefix="bucket-scale-")
-    bl = BucketList(disk_dir=tmp, disk_level=2)
+    executor = ThreadPoolExecutor(max_workers=2,
+                                  thread_name_prefix="bucket-merge")
+    bl = BucketList(executor=executor, disk_dir=tmp, disk_level=2)
     rss_start = rss_mb()
     t_start = time.time()
     close_times = []
@@ -56,6 +64,7 @@ def main():
                   flush=True)
     build_s = time.time() - t_start
     rss_after_build = rss_mb()
+    executor.shutdown(wait=True)
 
     # catchup-style streaming read of the full live set
     t0 = time.time()
@@ -66,7 +75,8 @@ def main():
     rss_after_stream = rss_mb()
     assert count == n_entries, (count, n_entries)
 
-    disk_files = [f for f in os.listdir(tmp) if f.startswith("bucket-")]
+    disk_files = [f for f in os.listdir(tmp)
+                  if f.startswith("bucket-") and f.endswith(".xdr")]
     disk_bytes = sum(
         os.path.getsize(os.path.join(tmp, f)) for f in disk_files)
     disk_levels = sum(
@@ -90,8 +100,12 @@ def main():
         "disk_bucket_bytes": disk_bytes,
         "disk_backed_buckets_live": disk_levels,
         "bucket_hash": bl.hash().hex(),
+        "merge_pipeline": {
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in bl.stats.items()},
+        "background_merges": True,
     }
-    with open(os.path.join(REPO, "BUCKET_SCALE_r05.json"), "w") as f:
+    with open(os.path.join(REPO, "BUCKET_SCALE_r06.json"), "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))
     import shutil
