@@ -44,7 +44,8 @@ def level_should_spill(ledger_seq: int, level: int) -> bool:
 class Bucket:
     """Immutable sorted run of (key, BucketEntry)."""
 
-    __slots__ = ("entries", "_hash", "_keys", "_stream", "_table")
+    __slots__ = ("entries", "_hash", "_keys", "_stream", "_table",
+                 "_index")
 
     EMPTY_HASH = b"\x00" * 32
 
@@ -54,6 +55,17 @@ class Bucket:
         self._keys: Optional[Tuple[bytes, ...]] = None
         self._stream: Optional[bytes] = None
         self._table = None
+        self._index = None
+
+    def ensure_index(self):
+        """The bucket's BucketIndex (bucket/index.py): exact dict for
+        small buckets, bloom + bisect for large ones; cached (immutable
+        bucket)."""
+        if self._index is None and self.entries:
+            from .index import MemBucketIndex
+
+            self._index = MemBucketIndex(self.keys)
+        return self._index
 
     @property
     def keys(self) -> Tuple[bytes, ...]:
@@ -394,7 +406,18 @@ class BucketList:
             "sync_fallback_merges": 0,
             "spill_wait_s": 0.0,
             "hash_s": 0.0,
+            # BucketListDB read-path counters (bucket/index.py):
+            # bucket_probes / point_reads is the probes-per-read figure
+            # the READ_BENCH artifact tracks (linear scan: ~#buckets)
+            "point_reads": 0,
+            "bucket_probes": 0,
+            "bloom_checks": 0,
+            "bloom_false_positives": 0,
+            "index_build_s": 0.0,
         }
+        # bloom-first point reads (default on); read_bench flips this
+        # off for the linear-scan baseline and the hash-parity check
+        self.index_enabled = True
 
     def hash(self) -> bytes:
         """Cumulative commitment: sha256 over all level hashes
@@ -423,6 +446,14 @@ class BucketList:
             for level in spilled:
                 self._stage_next_merge(level, ledger_seq)
         import time as _time
+
+        # index the close's new level-0 bucket at creation time (spill
+        # outputs are indexed by the merge that built them); the cost is
+        # tracked so READ_BENCH can prove it stays <10% of close p50
+        if self.index_enabled:
+            t0 = _time.perf_counter()
+            self.levels[0].curr.ensure_index()
+            self.stats["index_build_s"] += _time.perf_counter() - t0
 
         t0 = _time.perf_counter()
         out = self.hash()
@@ -479,7 +510,14 @@ class BucketList:
         if self.executor is not None and \
                 not (snap.is_empty() and curr.is_empty()):
             self.stats["sync_fallback_merges"] += 1
-        return merge_buckets(snap, curr, self._merge_dir(level + 1))
+        out = merge_buckets(snap, curr, self._merge_dir(level + 1))
+        if self.index_enabled and not out.is_empty():
+            import time as _time
+
+            t0 = _time.perf_counter()
+            out.ensure_index()
+            self.stats["index_build_s"] += _time.perf_counter() - t0
+        return out
 
     def _protect_bg_output(self, hash_hex: str) -> None:
         with self._bg_lock:
@@ -509,6 +547,8 @@ class BucketList:
         out = merge_buckets(newer, older, self._merge_dir(level + 1),
                             protect=self._protect_bg_output)
         out.hash()  # pre-hash too: off the close critical path
+        if self.index_enabled and not out.is_empty():
+            out.ensure_index()  # index handed off with the output
         return out
 
     def pending_merge_hashes(self) -> set:
@@ -520,20 +560,113 @@ class BucketList:
         with self._bg_lock:
             return set(self._bg_outputs)
 
-    # -- state access (catchup / BucketListDB-style lookups) ----------------
+    # -- state access (the BucketListDB read path) --------------------------
 
-    def get_entry(self, kb: bytes):
-        """Most-recent entry for a key across all levels (None if dead or
-        absent) — the BucketIndex lookup path (ref src/bucket/readme.md
-        BucketListDB design)."""
+    def _buckets_shallow_first(self):
         for lv in self.levels:
             for bucket in (lv.curr, lv.snap):
+                if not bucket.is_empty():
+                    yield bucket
+
+    def get_entry_record(self, kb: bytes):
+        """Most-recent BucketEntry for a key across all levels (None when
+        no level mentions it; a DEADENTRY result is an authoritative
+        "deleted").  With indexes on, each bucket's bloom filter is
+        consulted first and only filter hits probe the bucket's data —
+        ~1 probe/read instead of a scan of all 22 buckets (ref
+        src/bucket/readme.md BucketListDB design, BucketIndexImpl)."""
+        st = self.stats
+        st["point_reads"] += 1
+        if not self.index_enabled:
+            for bucket in self._buckets_shallow_first():
+                st["bucket_probes"] += 1
                 e = bucket.get(kb)
                 if e is not None:
-                    if e.type == BET.DEADENTRY:
-                        return None
-                    return e.value
+                    return e
+            return None
+        for bucket in self._buckets_shallow_first():
+            idx = bucket.ensure_index()
+            if idx is None:
+                continue
+            st["bloom_checks"] += 1
+            if not idx.may_contain(kb):
+                continue
+            st["bucket_probes"] += 1
+            e = idx.find(bucket, kb)
+            if e is None:
+                st["bloom_false_positives"] += 1
+                continue
+            return e
         return None
+
+    def get_entry(self, kb: bytes):
+        """Most-recent live entry for a key (None if dead or absent)."""
+        e = self.get_entry_record(kb)
+        if e is None or e.type == BET.DEADENTRY:
+            return None
+        return e.value
+
+    def get_entries(self, kbs) -> Dict[bytes, Optional[object]]:
+        """Batched point lookup: kb -> live entry value or None, walking
+        the levels once with the whole probe set (the prefetch feed for
+        LedgerTxnRoot; ref BucketListDB bulk load + the native
+        bucket_lower_bound batch kernel)."""
+        pending = list(dict.fromkeys(kbs))
+        out: Dict[bytes, Optional[object]] = {}
+        st = self.stats
+        st["point_reads"] += len(pending)
+        for bucket in self._buckets_shallow_first():
+            if not pending:
+                break
+            if self.index_enabled:
+                idx = bucket.ensure_index()
+                if idx is None:
+                    continue
+                st["bloom_checks"] += len(pending)
+                candidates = [kb for kb, hit in
+                              zip(pending, idx.check_batch(pending))
+                              if hit]
+                if not candidates:
+                    continue
+                st["bucket_probes"] += len(candidates)
+                found = idx.find_batch(bucket, candidates)
+            else:
+                candidates = pending
+                st["bucket_probes"] += len(candidates)
+                found = [bucket.get(kb) for kb in candidates]
+            hits = set()
+            for kb, e in zip(candidates, found):
+                if e is None:
+                    if self.index_enabled:
+                        st["bloom_false_positives"] += 1
+                    continue
+                out[kb] = (None if e.type == BET.DEADENTRY else e.value)
+                hits.add(kb)
+            if hits:
+                pending = [kb for kb in pending if kb not in hits]
+        for kb in pending:
+            out[kb] = None
+        return out
+
+    def ensure_indexes(self) -> None:
+        """Build any missing bucket indexes now (restore/adoption path);
+        build time lands in stats["index_build_s"]."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        for bucket in self._buckets_shallow_first():
+            bucket.ensure_index()
+        self.stats["index_build_s"] += _time.perf_counter() - t0
+
+    def index_memory_bytes(self) -> int:
+        """Resident bytes of all built indexes (bloom words + dict
+        estimates; memmapped tables count only their bloom)."""
+        total = 0
+        for bucket in self._buckets_shallow_first():
+            idx = getattr(bucket, "_index", None)
+            if idx is not None:
+                total += idx.nbytes
+        return total
 
     def iter_live_entries(self):
         """Stream the live entry set in key order with O(#buckets) memory:

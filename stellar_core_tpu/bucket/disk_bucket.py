@@ -51,7 +51,7 @@ class DiskBucket:
     """Immutable sorted run of BucketEntry backed by a file."""
 
     __slots__ = ("path", "count", "_hash", "page_keys", "page_offs",
-                 "size_bytes")
+                 "size_bytes", "_index", "_fd")
 
     def __init__(self, path: str, count: int, hash_: bytes,
                  page_keys: List[bytes], page_offs: List[int],
@@ -62,6 +62,15 @@ class DiskBucket:
         self.page_keys = page_keys
         self.page_offs = page_offs
         self.size_bytes = size_bytes
+        self._index = None
+        self._fd: Optional[int] = None
+
+    def __del__(self):
+        if getattr(self, "_fd", None) is not None:
+            try:
+                os.close(self._fd)
+            except OSError:
+                pass
 
     # -- interface shared with bucket_list.Bucket -------------------------
 
@@ -108,13 +117,63 @@ class DiskBucket:
                     e = T.BucketEntry.unpack(r)
                     yield entry_key_bytes(e), e
 
+    def ensure_index(self):
+        """The bucket's BucketIndex (bucket/index.py): bloom + memmapped
+        key/offset table from the sidecar.  Loaded from the persisted
+        bloom section when present; otherwise built from the entry table
+        and persisted (legacy PR-1 sidecars upgrade in place)."""
+        if self._index is not None or self.count == 0:
+            return self._index
+        from .index import (BloomFilter, DiskBucketIndex, load_disk_index)
+
+        idx = load_disk_index(_sidecar_path(self.path), self.count)
+        if idx is None:
+            t = _read_sidecar(self.path, expected_size=self.size_bytes)
+            if t is None:
+                t = _scan_tables(self.path)
+            eoff, elen, types, koff, klen, keys = t
+            bloom = BloomFilter.build_from_table(keys, koff, klen)
+            _write_sidecar(self.path, eoff, elen, types, koff, klen,
+                           keys if isinstance(keys, bytes)
+                           else bytes(keys), bloom=bloom)
+            idx = load_disk_index(_sidecar_path(self.path), self.count)
+            if idx is None:  # unwritable store: keep the in-RAM table
+                idx = DiskBucketIndex(eoff, elen, koff, klen, keys, bloom)
+        self._index = idx
+        return idx
+
+    def read_entry_at(self, offset: int, length: int):
+        """Decode the single BucketEntry at a known file span — the
+        index-hit read.  pread on a cached fd: one syscall, no seek
+        state, safe under concurrent readers (the point-read hot path
+        must not pay an open/close pair per lookup)."""
+        fd = self._fd
+        if fd is None:
+            fd = os.open(self.path, os.O_RDONLY)
+            # two racing openers: the check-and-store below has no GIL
+            # release point, so exactly one fd wins; the loser closes
+            # its own (no leak)
+            if self._fd is None:
+                self._fd = fd
+            else:
+                os.close(fd)
+                fd = self._fd
+        data = os.pread(fd, length, offset)
+        return T.BucketEntry.unpack(Reader(data))
+
     def get(self, kb: bytes):
-        """Key lookup: bisect the sparse index, scan one page (ref
-        BucketIndex::scan)."""
+        """Key lookup: exact index when built (binary-search the sidecar
+        key table, read one entry), else bisect the sparse page index and
+        scan one page (ref BucketIndex::scan)."""
         import bisect
 
         if self.count == 0:
             return None
+        if self._index is not None:
+            span = self._index.entry_span(kb)
+            if span is None:
+                return None
+            return self.read_entry_at(*span)
         i = bisect.bisect_right(self.page_keys, kb) - 1
         if i < 0:
             return None
@@ -195,7 +254,11 @@ class DiskBucket:
                        np.frombuffer(elen, dtype=np.int32),
                        np.frombuffer(types, dtype=np.int32),
                        koff, klen_np, b"".join(key_parts))
-        return cls(path, count, digest, page_keys, page_offs, off)
+        out = cls(path, count, digest, page_keys, page_offs, off)
+        from .index import load_disk_index
+
+        out._index = load_disk_index(_sidecar_path(path), count)
+        return out
 
     @classmethod
     def open(cls, path: str,
@@ -256,10 +319,17 @@ def _sidecar_path(path: str) -> str:
 
 
 def _write_sidecar(path: str, eoff, elen, types, koff, klen,
-                   keys: bytes) -> None:
-    """Persist the per-entry table next to the bucket stream (atomic)."""
+                   keys: bytes, bloom=None) -> None:
+    """Persist the per-entry table next to the bucket stream (atomic).
+    ``bloom`` (a bucket.index.BloomFilter) is appended as a trailing
+    section — absent for pre-index writers, ignored by pre-index readers
+    (they stop at the keys blob), so both directions stay compatible."""
     import numpy as np
 
+    if bloom is None:
+        from .index import BloomFilter
+
+        bloom = BloomFilter.build_from_table(keys, koff, klen)
     sp = _sidecar_path(path)
     tmp = f"{sp}.{os.getpid()}.tmp"
     try:
@@ -272,6 +342,7 @@ def _write_sidecar(path: str, eoff, elen, types, koff, klen,
             np.ascontiguousarray(koff, np.int64).tofile(f)
             np.ascontiguousarray(klen, np.int32).tofile(f)
             f.write(keys)
+            f.write(bloom.to_bytes())
         os.replace(tmp, sp)
     except OSError:
         try:
@@ -475,8 +546,14 @@ def merge_disk_native(directory: str, newer, older,
                            int(out_koff[i]) + int(out_klen[i])]
                  for i in range(0, n, PAGE)]
     page_offs = [int(o) for o in out_eoff[:n:PAGE]]
-    return DiskBucket(path, int(n), digest, page_keys, page_offs,
-                      int(out_bytes[0]))
+    out = DiskBucket(path, int(n), digest, page_keys, page_offs,
+                     int(out_bytes[0]))
+    # hand the index off with the bucket: built here (worker thread, off
+    # the close path) and adopted atomically with the merge output
+    from .index import load_disk_index
+
+    out._index = load_disk_index(_sidecar_path(path), int(n))
+    return out
 
 
 def _table_of(bucket):

@@ -152,35 +152,57 @@ class ApplyBucketsWork(BasicWork):
         db.execute("DELETE FROM offers")
         db.execute("DELETE FROM ledgerheaders")
         db.commit()
-        app.ledger_manager.root.clear_entry_cache()
-        with LedgerTxn(app.ledger_manager.root) as ltx:
-            ltx.set_header(header)
-            ltx.commit()
-        app.ledger_manager.root._header_cache = None
-        # stream the live set (bounded memory: deep levels may be disk
-        # buckets far larger than RAM), applying in batches like the
-        # reference's BucketApplicator chunks
-        def flush(batch):
-            app.invariants.check_on_bucket_apply(batch, header)
-            with LedgerTxn(app.ledger_manager.root) as ltx:
-                for e in batch:
-                    ltx.put(e)
+        root = app.ledger_manager.root
+        root.clear_entry_cache()
+        # the rebuild below streams the ENTIRE live set through root
+        # commits; overlay capture must be off for its duration or a
+        # 1M-entry catchup pins every decoded entry in the sql-ahead
+        # dict at once (the overlay is wholesale-reset afterwards — the
+        # assumed bucket list is authoritative)
+        bucket_reads_were = root.bucket_reads_enabled
+        saved_bucket_list = root._bucket_list
+        root.bucket_reads_enabled = False
+        root._bucket_list = None
+        try:
+            with LedgerTxn(root) as ltx:
+                ltx.set_header(header)
                 ltx.commit()
+            root._header_cache = None
 
-        batch: list = []
-        for kb, entry in bl.iter_live_entries():
-            batch.append(entry)
-            if len(batch) >= 4096:
+            # stream the live set (bounded memory: deep levels may be
+            # disk buckets far larger than RAM), applying in batches
+            # like the reference's BucketApplicator chunks
+            def flush(batch):
+                app.invariants.check_on_bucket_apply(batch, header)
+                with LedgerTxn(root) as ltx:
+                    for e in batch:
+                        ltx.put(e)
+                    ltx.commit()
+
+            batch: list = []
+            for kb, entry in bl.iter_live_entries():
+                batch.append(entry)
+                if len(batch) >= 4096:
+                    flush(batch)
+                    batch = []
+            if batch:
                 flush(batch)
-                batch = []
-        if batch:
-            flush(batch)
+        finally:
+            # restore the read source even on a failed/retried apply —
+            # a root left detached from the buckets would serve every
+            # later read from SQL silently
+            root._bucket_list = saved_bucket_list
+            root.bucket_reads_enabled = bucket_reads_were
         # invariant: per-entry lastModified stamps were overwritten by
         # put(); re-put with original values would need raw writes — the
         # bucket hash above already attested the true state, and the SQL
         # tier is a cache of it, so stamp drift is acceptable here (the
         # reference's BucketApplicator writes raw entries; tightened later)
         app.bucket_manager.assume_bucket_list(bl)
+        # the assumed bucket list is now authoritative: drop the entry
+        # cache + any stale sql-ahead overlay (BucketListDB-mode reads
+        # must serve the buckets' own entries)
+        root.clear_entry_cache()
         app.ledger_manager._lcl_hash = self.header_entry.hash
         app.ledger_manager._store_lcl(header)
         # keep the persisted restart state in step with the assumed bucket

@@ -48,6 +48,11 @@ from ..scp import local_node as LN
 # contraction kernel compiles once per node-universe size
 BATCH = 256
 
+# the native enumerator's SCC-width ceiling (native/quorum_enum.cpp
+# declines wider problems with rc=-3); past it the batched device
+# contractor is the documented last resort
+NATIVE_MAX_NODES = 1024
+
 
 class InterruptedError_(Exception):
     """Scan aborted via the interrupt flag
@@ -80,12 +85,18 @@ class QuorumIntersectionResult:
     def __init__(self, ok: Optional[bool],
                  split: Optional[Tuple[Set[bytes], Set[bytes]]] = None,
                  scanned: int = 0, scc_size: int = 0,
-                 aborted: bool = False):
+                 aborted: bool = False, tier: Optional[str] = None):
         self.ok = ok            # None when the scan was aborted (unknown)
         self.split = split
         self.scanned = scanned   # enumerator calls (subproblems examined)
         self.scc_size = scc_size
         self.aborted = aborted
+        # which evaluation tier answered: "native" / "numpy" / "device" /
+        # "deep-host", prefixed "org:" when the symmetric-org reduction
+        # collapsed the scan first (QUORUM_TIER_BENCH routing policy:
+        # native first everywhere its shape limits allow, device only as
+        # the >1024-node last resort)
+        self.tier = tier
 
 
 def tarjan_scc(nodes: List[bytes],
@@ -551,18 +562,59 @@ def _try_org_reduction(main_scc: List[bytes], qmap: Dict[bytes, object]):
     return org_reps, org_qmap, weak_reps, groups
 
 
+def _native_call_cap(max_calls: int, deadline) -> int:
+    """The native tier has no clock: convert the wall budget LEFT to a
+    call cap at its ~1M calls/s throughput (ADVICE r4: the cap must
+    shrink with elapsed time)."""
+    import time as _time
+
+    if deadline is None:
+        return max_calls
+    remaining = max(0.0, deadline - _time.monotonic())
+    time_cap = max(1, int(remaining * 1_000_000))
+    return min(max_calls or time_cap, time_cap)
+
+
 def _solve_org_level(org_qmap, weak_reps, groups, interrupt, use_device,
-                     max_calls=0, deadline=None):
+                     max_calls=0, deadline=None, use_native=True):
     """Run the enumerator on the collapsed org-level network and map a
-    found org split back to disjoint node-level quorums."""
+    found org split back to disjoint node-level quorums.  Returns
+    (split_or_None, calls, tier) — or raises _BudgetExhausted.
+
+    Tier routing (ISSUE r7 / QUORUM_TIER_BENCH): the native C++
+    enumerator answers first whenever its semantics apply — that is,
+    whenever there are no weak orgs (a weak org may serve two disjoint
+    node-level quorums, which needs the shareable-complement scan only
+    the Python enumerator implements).  The device-batch contractor is
+    NOT tried before native: measured at scc=24 it aborts a 120s budget
+    where native finishes in 0.18s."""
     reps = sorted(org_qmap)
-    contractor = _Contractor(reps, org_qmap, use_device)
-    enum = _MinQuorumEnumerator(contractor, interrupt, max_calls, deadline)
     n = len(reps)
-    shareable = np.array([r in weak_reps for r in reps], np.bool_)
-    found = enum.run(np.ones(n, np.bool_), shareable=shareable)
+    no_weak = not weak_reps
+    contractor = _Contractor(
+        reps, org_qmap,
+        use_device and (not use_native or n > NATIVE_MAX_NODES))
+    found = None
+    calls = 0
+    tier = None
+    if use_native and no_weak:
+        native_res = _check_native(contractor, interrupt,
+                                   _native_call_cap(max_calls, deadline))
+        if native_res is not None:
+            found, calls = native_res
+            if found == "aborted":
+                raise _BudgetExhausted(calls)
+            tier = "native"
+    if tier is None:
+        enum = _MinQuorumEnumerator(contractor, interrupt, max_calls,
+                                    deadline)
+        shareable = np.array([r in weak_reps for r in reps], np.bool_)
+        tier = "device" if contractor.use_device else \
+            ("deep-host" if contractor.deep else "numpy")
+        found = enum.run(np.ones(n, np.bool_), shareable=shareable)
+        calls = enum.calls
     if found is None:
-        return None, enum.calls
+        return None, calls, tier
     a_mask, b_mask = found
     a = {reps[j] for j in np.flatnonzero(a_mask)}
     b = {reps[j] for j in np.flatnonzero(b_mask)}
@@ -576,7 +628,7 @@ def _solve_org_level(org_qmap, weak_reps, groups, interrupt, use_device,
         # shared (necessarily weak) orgs serve both sides with disjoint
         # member slices: 2t <= |org|
         s2.update(members[-t:] if rep in a else members[:t])
-    return (s1, s2), enum.calls
+    return (s1, s2), calls, tier
 
 
 def check_quorum_intersection(qmap: Dict[bytes, object],
@@ -640,38 +692,47 @@ def check_quorum_intersection(qmap: Dict[bytes, object],
         reduction = _try_org_reduction(main_scc, qmap)
         if reduction is not None:
             _, org_qmap, weak_reps, groups = reduction
-            split, calls = _solve_org_level(org_qmap, weak_reps, groups,
-                                            interrupt, use_device,
-                                            max_calls, deadline)
+            split, calls, tier = _solve_org_level(
+                org_qmap, weak_reps, groups, interrupt, use_device,
+                max_calls, deadline, use_native=use_native)
+            tier = "org:" + tier
+            _log_tier(tier, n, calls)
             if split is not None:
-                return QuorumIntersectionResult(False, split, calls, n)
-            return QuorumIntersectionResult(True, None, calls, n)
+                return QuorumIntersectionResult(False, split, calls, n,
+                                                tier=tier)
+            return QuorumIntersectionResult(True, None, calls, n,
+                                            tier=tier)
 
-        contractor = _Contractor(main_scc, qmap, use_device)
+        # device-batch contraction is the documented last resort: only
+        # past the native tier's width ceiling (or when native is
+        # explicitly disabled for benchmarking) — QUORUM_TIER_BENCH
+        # measured the device tier aborting a 120s budget at scc=24
+        # where native answers in 0.18s
+        contractor = _Contractor(
+            main_scc, qmap,
+            use_device and (not use_native or n > NATIVE_MAX_NODES))
         if use_native:
-            # the native tier has no clock: convert the wall budget LEFT
-            # after the org-reduction attempt to a call cap at its ~1M
-            # calls/s throughput (ADVICE r4: the cap must shrink with
-            # elapsed time, and an abort must report actual calls)
-            native_calls = max_calls
-            if deadline is not None:
-                remaining = max(0.0, deadline - _time.monotonic())
-                time_cap = max(1, int(remaining * 1_000_000))
-                native_calls = min(native_calls or time_cap, time_cap)
-            native_res = _check_native(contractor, interrupt, native_calls)
+            native_res = _check_native(
+                contractor, interrupt, _native_call_cap(max_calls,
+                                                        deadline))
             if native_res is not None:
                 found, calls = native_res
                 if found == "aborted":
                     return QuorumIntersectionResult(None, None, calls, n,
-                                                    aborted=True)
+                                                    aborted=True,
+                                                    tier="native")
+                _log_tier("native", n, calls)
                 if found is not None:
                     q1, q2 = found
                     return QuorumIntersectionResult(
                         False,
                         ({main_scc[j] for j in np.flatnonzero(q1)},
                          {main_scc[j] for j in np.flatnonzero(q2)}),
-                        calls, n)
-                return QuorumIntersectionResult(True, None, calls, n)
+                        calls, n, tier="native")
+                return QuorumIntersectionResult(True, None, calls, n,
+                                                tier="native")
+        tier = "device" if contractor.use_device else \
+            ("deep-host" if contractor.deep else "numpy")
         enum = _MinQuorumEnumerator(contractor, interrupt, max_calls,
                                     deadline)
         found = enum.run(np.ones(n, np.bool_))
@@ -679,14 +740,25 @@ def check_quorum_intersection(qmap: Dict[bytes, object],
         scanned = exc.args[0] if exc.args else max_calls
         return QuorumIntersectionResult(None, None, scanned, n,
                                         aborted=True)
+    _log_tier(tier, n, enum.calls)
     if found is not None:
         q1, q2 = found
         return QuorumIntersectionResult(
             False,
             ({main_scc[j] for j in np.flatnonzero(q1)},
              {main_scc[j] for j in np.flatnonzero(q2)}),
-            enum.calls, n)
-    return QuorumIntersectionResult(True, None, enum.calls, n)
+            enum.calls, n, tier=tier)
+    return QuorumIntersectionResult(True, None, enum.calls, n, tier=tier)
+
+
+def _log_tier(tier: str, scc_size: int, calls: int) -> None:
+    """Operators asked which tier answered a scan (satellite r7): one
+    info line per completed scan, Herder partition."""
+    from ..utils.logging import get_logger
+
+    get_logger("Herder").info(
+        "quorum intersection answered by %s tier (scc=%d, calls=%d)",
+        tier, scc_size, calls)
 
 
 def _contract_host(members: Set[bytes],

@@ -30,7 +30,10 @@ class LedgerCloseData:
 class LedgerManager:
     def __init__(self, app):
         self.app = app
-        self.root = LedgerTxnRoot(app.database)
+        # late-bound bucket source: restore/assume swap the list object
+        self.root = LedgerTxnRoot(
+            app.database,
+            bucket_list=lambda: app.bucket_manager.bucket_list)
         self._lcl_hash: Optional[bytes] = None
         self.metrics = app.metrics
         # per-phase breakdown of the most recent close (ms), plus
@@ -238,8 +241,9 @@ class LedgerManager:
             bl = self.app.bucket_manager.bucket_list
             stats0 = dict(bl.stats)
             t0 = perf_counter()
+            bucket_changes = self._collect_changes(ltx)
             bucket_hash = self.app.bucket_manager.add_batch(
-                close_data.ledger_seq, self._collect_changes(ltx))
+                close_data.ledger_seq, bucket_changes)
             t1 = perf_counter()
             self._phase(phases, "bucket", t0, t1)
             phases["spill_wait"] = round(
@@ -263,6 +267,9 @@ class LedgerManager:
                                    tx_result_metas)
             ltx.commit()
 
+        # the buckets now cover this close's delta: bucket-mode reads no
+        # longer need the commit's sql-ahead overlay copies
+        self.root.note_bucket_applied(kb for kb, _, _ in bucket_changes)
         new_header = self.root.header()
         self._lcl_hash = xdr_sha256(T.LedgerHeader, new_header)
         self._store_lcl(new_header)
@@ -323,6 +330,17 @@ class LedgerManager:
             "VALUES('bucketlist', ?) ON CONFLICT(statename) "
             "DO UPDATE SET state=excluded.state",
             (json.dumps(hashes),))
+        # the sql-ahead overlay keys persist WITH the bucket state: a
+        # restarted node re-verifies the buckets against the header but
+        # can never re-derive which keys only ever lived in SQL (genesis
+        # root before its first fee debit, test-rig bulk seeds) — losing
+        # them would make BucketListDB-mode reads miss live entries
+        self.app.database.execute(
+            "INSERT INTO persistentstate(statename, state) "
+            "VALUES('sqlahead', ?) ON CONFLICT(statename) "
+            "DO UPDATE SET state=excluded.state",
+            (json.dumps(sorted(kb.hex()
+                               for kb in self.root._sql_ahead)),))
         self.app.database.commit()
         bm.gc_unreferenced()
 

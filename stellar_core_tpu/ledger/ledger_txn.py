@@ -382,13 +382,17 @@ def _offer_order_key(entry) -> Tuple[float, int]:
 
 
 class LedgerTxnRoot(AbstractLedgerTxn):
-    """Root layer: SQLite-backed entry store + header (ref LedgerTxnRoot
-    with the per-type SQL adapters collapsed into a keyed store + an offers
-    index for order-book scans — SURVEY.md §2.4/§2.11)."""
+    """Root layer: entry store + header.  Point reads are served from the
+    bucket tier when BucketListDB mode is enabled (ref BucketListDB /
+    EXPERIMENTAL_BUCKETLIST_DB: the bucket list with per-bucket indexes
+    IS the ledger-state database, SQL keeps only the offer-book range
+    scans); otherwise — and always for offer/prefix scans — SQLite with
+    the per-type SQL adapters collapsed into a keyed store + an offers
+    index (SURVEY.md §2.4/§2.11)."""
 
     ENTRY_CACHE_SIZE = 8192
 
-    def __init__(self, db):
+    def __init__(self, db, bucket_list=None):
         self.db = db
         self._child: Optional[LedgerTxn] = None
         self._header_cache = None
@@ -401,6 +405,32 @@ class LedgerTxnRoot(AbstractLedgerTxn):
             OrderedDict()
         self.cache_hits = 0
         self.cache_misses = 0
+        # -- BucketListDB read mode ----------------------------------------
+        # bucket_list: zero-arg callable returning the live BucketList
+        # (late-bound: restore/assume swap the list object).  Reads only
+        # divert once enable_bucket_reads() ran — the Application enables
+        # it on a fresh start or after a hash-verified bucket restore, so
+        # a node whose bucket store is missing/stale keeps SQL serving.
+        self._bucket_list = bucket_list
+        self.bucket_reads_enabled = False
+        # writes committed to SQL OUTSIDE a ledger close (genesis seeding,
+        # test-rig bulk writers) never reach the bucket list; this overlay
+        # keeps them visible to the bucket read path.  Close deltas enter
+        # at commit and are dropped again once the close's add_batch has
+        # folded them into the buckets (LedgerManager calls
+        # note_bucket_applied), so in steady state it holds only the
+        # never-closed stragglers.
+        self._sql_ahead: Dict[bytes, Optional[object]] = {}
+        self.reads_from_buckets = 0
+        self.reads_from_sql = 0
+        self.reads_from_overlay = 0
+
+    def enable_bucket_reads(self) -> None:
+        if self._bucket_list is not None:
+            self.bucket_reads_enabled = True
+
+    def _bucket_reads_on(self) -> bool:
+        return self.bucket_reads_enabled and self._bucket_list is not None
 
     # -- reads -------------------------------------------------------------
 
@@ -413,21 +443,60 @@ class LedgerTxnRoot(AbstractLedgerTxn):
 
     def clear_entry_cache(self) -> None:
         """Required after any write that bypasses _commit_from_child
-        (bucket-apply catchup wiping the SQL store)."""
+        (bucket-apply catchup wiping the SQL store).  The sql-ahead
+        overlay clears with it: callers that wipe the store are about to
+        make the bucket list authoritative."""
         self._entry_cache.clear()
+        self._sql_ahead.clear()
+
+    def note_bucket_applied(self, kbs) -> None:
+        """A ledger close folded these keys into the bucket list — the
+        buckets now answer for them, drop the overlay copies."""
+        for kb in kbs:
+            self._sql_ahead.pop(kb, None)
+
+    def load_sql_ahead(self, kbs) -> None:
+        """Rebuild the overlay after a restart from its persisted key
+        list (LedgerManager stores it with the bucket state): each key's
+        current SQL row is authoritative — including absence, which must
+        shadow any stale bucket entry as a deletion."""
+        for kb in kbs:
+            row = self.db.execute(
+                "SELECT entry FROM ledgerentries WHERE key = ?",
+                (kb,)).fetchone()
+            self._sql_ahead[kb] = (T.LedgerEntry.decode(row[0])
+                                   if row is not None else None)
 
     def prefetch(self, kbs) -> int:
         """Bulk-load entries into the cache ahead of an apply loop (ref
         LedgerTxnRoot::prefetch).  Returns the number of keys newly
-        cached (positive or negative)."""
+        cached (positive or negative).  BucketListDB mode feeds this from
+        the bucket tier's batched lookup — zero SQL on the point path."""
         missing = [kb for kb in kbs if kb not in self._entry_cache]
         n = 0
+        if self._bucket_reads_on():
+            ask = []
+            for kb in missing:
+                if kb in self._sql_ahead:
+                    self.reads_from_overlay += 1
+                    self._cache_put(kb, self._sql_ahead[kb])
+                    n += 1
+                else:
+                    ask.append(kb)
+            if ask:
+                found = self._bucket_list().get_entries(ask)
+                self.reads_from_buckets += len(ask)
+                for kb in ask:
+                    self._cache_put(kb, found.get(kb))
+                    n += 1
+            return n
         for i in range(0, len(missing), 500):
             chunk = missing[i:i + 500]
             marks = ",".join("?" * len(chunk))
             found = dict(self.db.execute(
                 f"SELECT key, entry FROM ledgerentries "
                 f"WHERE key IN ({marks})", chunk))
+            self.reads_from_sql += len(chunk)
             for kb in chunk:
                 blob = found.get(kb)
                 self._cache_put(
@@ -447,10 +516,20 @@ class LedgerTxnRoot(AbstractLedgerTxn):
             self._entry_cache.move_to_end(kb)
             return cached
         self.cache_misses += 1
-        row = self.db.execute(
-            "SELECT entry FROM ledgerentries WHERE key = ?", (kb,)
-        ).fetchone()
-        entry = T.LedgerEntry.decode(row[0]) if row is not None else None
+        if self._bucket_reads_on():
+            if kb in self._sql_ahead:
+                self.reads_from_overlay += 1
+                entry = self._sql_ahead[kb]
+            else:
+                self.reads_from_buckets += 1
+                entry = self._bucket_list().get_entry(kb)
+        else:
+            self.reads_from_sql += 1
+            row = self.db.execute(
+                "SELECT entry FROM ledgerentries WHERE key = ?", (kb,)
+            ).fetchone()
+            entry = (T.LedgerEntry.decode(row[0])
+                     if row is not None else None)
         self._cache_put(kb, entry)
         return entry
 
@@ -477,6 +556,15 @@ class LedgerTxnRoot(AbstractLedgerTxn):
                         "sponsorship)")
                 continue
             self._cache_put(kb, entry)  # write-through (None = deleted)
+            if self._bucket_list is not None:
+                # keep the write visible to bucket-mode reads until the
+                # close folds it into the buckets (note_bucket_applied);
+                # direct (non-close) commits stay here for good.  Tracked
+                # even while bucket reads are OFF: the overlay key list
+                # persists with the bucket state, and a node later
+                # restarted with BUCKETLIST_DB on must still know which
+                # entries only ever lived in SQL
+                self._sql_ahead[kb] = entry
             if entry is None:
                 cur.execute("DELETE FROM ledgerentries WHERE key = ?", (kb,))
                 cur.execute("DELETE FROM offers WHERE key = ?", (kb,))

@@ -99,8 +99,19 @@ class Application:
                 gc.freeze()
                 gc.disable()
         if self.ledger_manager.load_last_known_ledger():
-            self._restore_bucket_state()
+            restored = self._restore_bucket_state()
+            # BucketListDB reads only activate when the bucket list
+            # provably matches the last closed header; a node without a
+            # (verified) bucket store keeps serving reads from SQL
+            if restored and self.config.BUCKETLIST_DB:
+                self.ledger_manager.root.enable_bucket_reads()
+                self._restore_sql_ahead()
         else:
+            if self.config.BUCKETLIST_DB:
+                # fresh start: the bucket list begins empty and every
+                # close folds its delta in, so it stays authoritative
+                # from genesis (direct writes ride the sql-ahead overlay)
+                self.ledger_manager.root.enable_bucket_reads()
             self.ledger_manager.start_new_ledger()
         self.herder.start()
         if self.overlay_manager is not None:
@@ -117,22 +128,24 @@ class Application:
         self.history_manager.publish_queued_history()
         self._started = True
 
-    def _restore_bucket_state(self) -> None:
+    def _restore_bucket_state(self) -> bool:
         """Reassume the bucket list from the persisted level hashes + the
         on-disk bucket files (ref ApplicationImpl::start :788 ->
-        loadLastKnownLedger -> AssumeStateWork)."""
+        loadLastKnownLedger -> AssumeStateWork).  True when the restored
+        list hash-matches the last closed header (the gate for
+        BucketListDB reads)."""
         import json
 
         if self.bucket_manager.bucket_dir is None:
             # no on-disk bucket store configured: nothing to restore from
             # (state hashes can't be rebuilt; catchup from an archive is
             # the rejoin path for such nodes)
-            return
+            return False
         row = self.database.execute(
             "SELECT state FROM persistentstate WHERE "
             "statename='bucketlist'").fetchone()
         if row is None:
-            return
+            return False
         level_hashes = [tuple(p) for p in json.loads(row[0])]
         self.bucket_manager.restore_from_level_hashes(level_hashes)
         hdr = self.ledger_manager.last_closed_header()
@@ -141,6 +154,27 @@ class Application:
             raise RuntimeError(
                 "restored bucket list does not match the last closed "
                 "header's bucketListHash")
+        if self.config.BUCKETLIST_DB:
+            # build/load every bucket's index NOW (persisted sidecar
+            # blooms make this a memmap open; legacy pre-index sidecars
+            # upgrade here, at boot) — never as a multi-second stall
+            # inside the first point read of the apply path
+            self.bucket_manager.bucket_list.ensure_indexes()
+        return True
+
+    def _restore_sql_ahead(self) -> None:
+        """Reload the sql-ahead overlay's persisted key list (stored
+        alongside the bucket state): entries that only ever lived in SQL
+        must stay visible to BucketListDB-mode reads across restarts."""
+        import json
+
+        row = self.database.execute(
+            "SELECT state FROM persistentstate WHERE "
+            "statename='sqlahead'").fetchone()
+        if row is None:
+            return
+        self.ledger_manager.root.load_sql_ahead(
+            bytes.fromhex(h) for h in json.loads(row[0]))
 
     def crank(self, block: bool = False) -> int:
         n = self.clock.crank(block)

@@ -130,10 +130,13 @@ def cmd_self_check(cfg: Config) -> int:
         consistent = len(live) == db_count
         if consistent:
             for kb, entry in live.items():
-                db_entry = app.ledger_manager.root.get(kb)
-                if db_entry is None or \
-                        T.LedgerEntry.encode(db_entry) != \
-                        T.LedgerEntry.encode(entry):
+                # straight SQL, NOT root.get: in BucketListDB mode the
+                # root serves from the buckets, which would make this
+                # cross-tier invariant compare the buckets to themselves
+                row = app.database.execute(
+                    "SELECT entry FROM ledgerentries WHERE key = ?",
+                    (kb,)).fetchone()
+                if row is None or row[0] != T.LedgerEntry.encode(entry):
                     consistent = False
                     break
         checks["bucketlist_consistent_with_database"] = consistent

@@ -154,6 +154,14 @@ class Config:
         # memory (ref BucketListDB; levels 0-3 hold <= 4^4 ledgers of
         # deltas and stay hot)
         self.DISK_BUCKET_LEVEL: int = kw.get("DISK_BUCKET_LEVEL", 4)
+        # serve point reads / apply-loop prefetch from the bucket tier's
+        # bloom-filtered per-bucket indexes instead of SQL (ref
+        # BucketListDB / EXPERIMENTAL_BUCKETLIST_DB — default on; SQL
+        # keeps only the offer-book range scans).  Activation still
+        # requires a fresh start or a hash-verified bucket restore
+        # (Application.start) so a node with a missing/stale bucket store
+        # never serves wrong reads.
+        self.BUCKETLIST_DB: bool = kw.get("BUCKETLIST_DB", True)
         # run GC between closes instead of wherever allocation counters
         # trip (a mid-close gen2 cycle costs >1s at 1000-tx closes)
         self.DEFERRED_GC: bool = kw.get("DEFERRED_GC", True)

@@ -67,6 +67,25 @@ class CommandHandler:
         snap["bucket.merge.pipeline"] = {
             k: (round(v, 4) if isinstance(v, float) else v)
             for k, v in bl.stats.items()}
+        # BucketListDB read path at a glance: which tier answered point
+        # reads, probes per read (the bloom filters' whole point), FP
+        # rate, and the indexes' resident cost
+        reads = bl.stats["point_reads"]
+        probes = bl.stats["bucket_probes"]
+        checks = bl.stats["bloom_checks"]
+        snap["bucket.read.path"] = {
+            "enabled": root.bucket_reads_enabled,
+            "served_by": {"bucket": root.reads_from_buckets,
+                          "overlay": root.reads_from_overlay,
+                          "sql": root.reads_from_sql},
+            "point_reads": reads,
+            "probes_per_read": round(probes / reads, 4) if reads else 0.0,
+            "bloom_false_positive_rate": round(
+                bl.stats["bloom_false_positives"] / checks, 6)
+            if checks else 0.0,
+            "index_memory_bytes": bl.index_memory_bytes(),
+            "index_build_s": round(bl.stats["index_build_s"], 4),
+        }
         return 200, {"metrics": snap}
 
     def peers(self, params):
@@ -82,7 +101,8 @@ class CommandHandler:
             res = self.app.herder.check_quorum_intersection()
             body = {"intersection": res.ok,  # null = scan budget hit
                     "scanned_subsets": res.scanned,
-                    "scc_size": res.scc_size}
+                    "scc_size": res.scc_size,
+                    "tier": res.tier}
             if res.aborted:
                 body["aborted"] = True
             if res.split:
@@ -155,6 +175,29 @@ class CommandHandler:
         mode = params.get("mode", "pay")
         n_accounts = int(params.get("accounts", "100"))
         n_txs = int(params.get("txs", "100"))
+
+        # rate mode: generateload?mode=pay&rate=N&duration=S starts a
+        # timer-driven tx/s run (ref LoadGenerator.h:28-36); mode=status
+        # polls it, mode=stop cancels it
+        if mode == "status":
+            return 200, {"rate_run": lg.rate_status()}
+        if mode == "stop":
+            lg.stop_rate_run()
+            return 200, {"rate_run": lg.rate_status()}
+        if "rate" in params:
+            if mode not in ("pay", "pretend", "mixed"):
+                return 400, {"error": f"rate mode needs pay/pretend/"
+                                      f"mixed, got {mode!r}"}
+            if not lg.accounts:
+                lg.restore_accounts()
+            if not lg.accounts:
+                return 400, {"error": "run mode=create (and close) first"}
+            status = lg.start_rate_run(
+                mode, rate=float(params["rate"]),
+                duration=float(params.get("duration", "10")),
+                dex_percent=int(params.get("dexpct", "50")),
+                op_count=int(params.get("opcount", "1")))
+            return 200, {"rate_run": status}
 
         def submit(envs, note=None, on_all_pending=None):
             statuses: dict = {}

@@ -111,6 +111,21 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64),
         ]
+        if hasattr(lib, "bloom_fill"):
+            pu64 = ctypes.POINTER(ctypes.c_uint64)
+            lib.bloom_fill.restype = None
+            lib.bloom_fill.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+                pu64, ctypes.c_int64,
+            ]
+            lib.bloom_check.restype = None
+            lib.bloom_check.argtypes = [
+                pu64, ctypes.c_int64,
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32),
+            ]
         _lib = lib
         return _lib
 

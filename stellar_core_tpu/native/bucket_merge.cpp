@@ -42,6 +42,42 @@ constexpr int32_t kLive = 0;
 constexpr int32_t kDead = 1;
 constexpr int32_t kInit = 2;
 
+// ---- CRC-32 (ISO-HDLC, reflected, poly 0xEDB88320) ------------------------
+// Bit-identical to Python's zlib.crc32(data, start) so the bloom filters
+// built here and the Python fallback tier (bucket/index.py) interoperate:
+// a filter persisted by either side answers queries from the other.
+
+struct Crc32Table {
+  uint32_t t[256];
+  Crc32Table() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1u) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+  }
+};
+
+uint32_t crc32_update(uint32_t start, const uint8_t* data, int32_t len) {
+  static const Crc32Table table;
+  uint32_t crc = start ^ 0xFFFFFFFFu;
+  for (int32_t i = 0; i < len; ++i)
+    crc = table.t[(crc ^ data[i]) & 0xFFu] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+// blocked-bloom probe layout shared with bucket/index.py: h1 selects the
+// 64-bit block, four 6-bit slices of h2 select bits inside it
+constexpr uint32_t kBloomSeed2 = 0x9E3779B9u;
+
+uint64_t bloom_mask(uint32_t h2) {
+  uint64_t m = 0;
+  for (int shift = 0; shift < 24; shift += 6)
+    m |= 1ull << ((h2 >> shift) & 63u);
+  return m;
+}
+
 // ---- SHA-256 (FIPS 180-4), self-contained so the whole merge --------------
 // (compare + copy + bucket hash) runs inside one GIL-free native call.
 
@@ -359,6 +395,41 @@ void bucket_lower_bound(
       }
     }
     out_pos[p] = lo;
+  }
+}
+
+// Fill a blocked bloom filter over a key table (the per-bucket
+// BucketIndex filter, ref src/bucket/BucketIndexImpl.cpp's binary fuse /
+// bloom layer).  words must be zeroed, n_blocks 64-bit blocks.
+void bloom_fill(const uint8_t* keys, const int64_t* k_off,
+                const int32_t* k_len, int64_t n_keys, uint64_t* words,
+                int64_t n_blocks) {
+  if (n_blocks <= 0) return;
+  for (int64_t i = 0; i < n_keys; ++i) {
+    const uint8_t* k = keys + k_off[i];
+    uint32_t h1 = crc32_update(0, k, k_len[i]);
+    uint32_t h2 = crc32_update(kBloomSeed2, k, k_len[i]);
+    words[h1 % static_cast<uint64_t>(n_blocks)] |= bloom_mask(h2);
+  }
+}
+
+// Batched membership check against a blocked bloom filter: out_hit[p]=1
+// when the filter MAY contain probe p (0 = definitely absent).
+void bloom_check(const uint64_t* words, int64_t n_blocks,
+                 const uint8_t* probes, const int64_t* p_off,
+                 const int32_t* p_len, int64_t n_probes,
+                 int32_t* out_hit) {
+  for (int64_t p = 0; p < n_probes; ++p) {
+    if (n_blocks <= 0) {
+      out_hit[p] = 0;
+      continue;
+    }
+    const uint8_t* k = probes + p_off[p];
+    uint32_t h1 = crc32_update(0, k, p_len[p]);
+    uint32_t h2 = crc32_update(kBloomSeed2, k, p_len[p]);
+    uint64_t m = bloom_mask(h2);
+    out_hit[p] =
+        (words[h1 % static_cast<uint64_t>(n_blocks)] & m) == m ? 1 : 0;
   }
 }
 

@@ -20,6 +20,9 @@ from ..xdr import types as T
 CREATE = "create"
 PAY = "pay"
 
+# rate mode: generation quantum (seconds of virtual/real clock per tick)
+RATE_TICK_SECONDS = 1.0
+
 
 class LoadGenerator:
     def __init__(self, app):
@@ -27,6 +30,8 @@ class LoadGenerator:
         self.network_id = app.config.network_id()
         self.accounts: List[SecretKey] = []
         self._seqs = {}
+        self._rate_timer = None
+        self._rate_state: Optional[dict] = None
 
     # -- deterministic account derivation -----------------------------------
 
@@ -235,6 +240,125 @@ class LoadGenerator:
                 out.append(self.payment_envelope(src, dest,
                                                  1 + (i % 1000)))
         return out
+
+    # -- RATE mode (timer-driven tx/s; ref LoadGenerator.h:28-36) -----------
+
+    def start_rate_run(self, mode: str = PAY, rate: float = 10.0,
+                       duration: float = 10.0, dex_percent: int = 50,
+                       op_count: int = 1) -> dict:
+        """Sustain ``rate`` tx/s for ``duration`` clock-seconds (the
+        reference's generateLoad txRate scheduling): a VirtualTimer ticks
+        every RATE_TICK_SECONDS and ENQUEUES the generation work on the
+        app's fair scheduler (utils/scheduler.py, queue "loadgen"), so
+        sustained load shares the crank with consensus instead of
+        preempting it — the soak shape that makes queue aging, banning
+        and rebroadcast reachable.  Returns the initial status dict."""
+        from ..utils.clock import VirtualTimer
+
+        assert mode in (PAY, "pretend", "mixed"), mode
+        assert self.accounts, "CREATE accounts first"
+        if mode == "mixed":
+            assert getattr(self, "dex_asset", None) is not None, \
+                "setup_dex() first"
+        self.stop_rate_run()
+        clock = self.app.clock
+        self._rate_state = {
+            "mode": mode, "rate": float(rate),
+            "deadline": clock.now() + float(duration),
+            "dex_percent": int(dex_percent), "op_count": int(op_count),
+            "submitted": 0, "status_counts": {}, "ticks": 0,
+            "cursor": 0, "carry": 0.0, "last": clock.now(),
+            "running": True, "stopped": False,
+        }
+        self._rate_timer = VirtualTimer(clock)
+        self._arm_rate_tick()
+        return self.rate_status()
+
+    def stop_rate_run(self) -> None:
+        if self._rate_timer is not None:
+            self._rate_timer.cancel()
+            self._rate_timer = None
+        if self._rate_state is not None:
+            self._rate_state["running"] = False
+            # an operator stop also voids batches already enqueued on
+            # the scheduler (deadline expiry does NOT: the final tick's
+            # batch covers the run's last second and must submit)
+            self._rate_state["stopped"] = True
+
+    def rate_status(self) -> dict:
+        st = self._rate_state
+        if st is None:
+            return {"running": False}
+        return {"running": st["running"], "mode": st["mode"],
+                "rate": st["rate"], "ticks": st["ticks"],
+                "submitted": st["submitted"],
+                "status_counts": {str(k): v for k, v
+                                  in st["status_counts"].items()},
+                "remaining_seconds": round(
+                    max(0.0, st["deadline"] - self.app.clock.now()), 3)}
+
+    def _arm_rate_tick(self) -> None:
+        t = self._rate_timer
+        t.expires_from_now(RATE_TICK_SECONDS)
+        t.async_wait(self._rate_tick)
+
+    def _rate_tick(self) -> None:
+        st = self._rate_state
+        if st is None or not st["running"]:
+            return
+        clock = self.app.clock
+        now = clock.now()
+        want = st["rate"] * (now - st["last"]) + st["carry"]
+        n = int(want)
+        st["carry"] = want - n
+        st["last"] = now
+        st["ticks"] += 1
+        if n > 0:
+            # generation/submission runs as a fair-scheduled action, not
+            # inside the timer callback; the batch binds ITS run's state
+            # so a stop/start can never replay it against the new run
+            self.app.scheduler.enqueue(
+                "loadgen", lambda st=st, n=n: self._rate_generate(st, n))
+        if now < st["deadline"]:
+            self._arm_rate_tick()
+        else:
+            st["running"] = False
+            self._rate_timer = None
+
+    def _rate_generate(self, st: dict, n: int) -> None:
+        from ..herder.tx_queue import TransactionQueue
+
+        if st["stopped"]:
+            return
+        accts = self.accounts
+        k = len(accts)
+        for _ in range(n):
+            i = st["cursor"]
+            st["cursor"] += 1
+            src = accts[i % k]
+            if st["mode"] == "pretend":
+                env = self.pretend_envelope(src, st["op_count"])
+            elif st["mode"] == "mixed" and \
+                    (i * 7919 + 13) % 100 < st["dex_percent"]:
+                env = self.offer_envelope(
+                    src, 10 + i % 90, 100 + (i % 50), 100)
+            else:
+                dest = accts[(i + 1) % k].public_key().raw
+                # fee spread: sustained overload must exercise the
+                # fee-rate eviction (and ban) path, which uniform fees
+                # never trigger
+                env = self.payment_envelope(src, dest, 1 + (i % 1000),
+                                            fee=100 + (i % 16) * 25)
+            r = self.app.herder.recv_transaction(env)
+            st["submitted"] += 1
+            st["status_counts"][r] = st["status_counts"].get(r, 0) + 1
+            if r not in (TransactionQueue.ADD_STATUS_PENDING,
+                         TransactionQueue.ADD_STATUS_DUPLICATE):
+                # the queue did not take it: the cached seqnum must roll
+                # back or every later tx from this source is a seq gap
+                pub = src.public_key().raw
+                if pub in self._seqs:
+                    self._seqs[pub] -= 1
 
     # -- shared signing -----------------------------------------------------
 

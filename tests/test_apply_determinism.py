@@ -1,0 +1,64 @@
+"""Apply-path determinism guard (ROADMAP item 5, ISSUE r7 satellite):
+closing the same tx sets from the same snapshot must produce
+bit-identical ledger hashes AND bit-identical meta streams.
+
+The bucket tier has carried a repeated-run guard since PR 1
+(test_bucket_list.py); this is the same discipline for the transaction
+apply machinery — fee processing, hash-shuffled apply order, DEX
+crossing, meta emission — whose nondeterminism would fork a validator
+quorum even when each node's bucket merges are individually sound.
+"""
+from stellar_core_tpu.main import Application, test_config
+from stellar_core_tpu.main.http_server import CommandHandler
+from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+from stellar_core_tpu.xdr import types as T
+
+
+def _run_mixed_workload():
+    """One full node lifecycle over a deterministic mixed workload:
+    account seeding, DEX seeding (issuer/trustlines/funding), then mixed
+    payment+offer closes — all REAL transactions.  Returns the per-close
+    fingerprint: (ledger hash, bucket hash, encoded meta bytes)."""
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), test_config(
+        TESTING_UPGRADE_MAX_TX_SET_SIZE=200))
+    app.start()
+    handler = CommandHandler(app)
+    fingerprints = []
+
+    def close():
+        app.herder.manual_close()
+        meta = app._meta_stream[-1] if app._meta_stream else None
+        fingerprints.append((
+            app.ledger_manager.last_closed_hash(),
+            app.bucket_manager.get_bucket_list_hash(),
+            T.LedgerCloseMeta.encode(meta) if meta is not None else b""))
+
+    code, body = handler.handle("generateload",
+                                {"mode": "create", "accounts": "30"})
+    assert code == 200, body
+    close()
+    for _ in range(3):  # issuer, trustlines, funding
+        code, body = handler.handle("generateload",
+                                    {"mode": "mixed", "txs": "60"})
+        assert code == 200, body
+        close()
+    for _ in range(4):
+        code, body = handler.handle(
+            "generateload", {"mode": "mixed", "txs": "60", "dexpct": "45"})
+        assert code == 200, body
+        assert body["status_counts"] == {0: 60}, body
+        close()
+    app.graceful_stop()
+    return fingerprints
+
+
+def test_same_tx_sets_close_bit_identical_twice():
+    run1 = _run_mixed_workload()
+    run2 = _run_mixed_workload()
+    assert len(run1) == len(run2) >= 8
+    for i, (a, b) in enumerate(zip(run1, run2)):
+        assert a[0] == b[0], f"ledger hash diverged at close {i}"
+        assert a[1] == b[1], f"bucket list hash diverged at close {i}"
+        assert a[2] == b[2], f"tx meta diverged at close {i}"
+    # the workload actually exercised the apply path (nonempty metas)
+    assert any(len(m) > 200 for _, _, m in run1)

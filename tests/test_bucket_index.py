@@ -1,0 +1,400 @@
+"""BucketListDB read subsystem (ISSUE r7 tentpole): per-bucket bloom
+filters + exact key/offset indexes (bucket/index.py), the bloom-first
+BucketList point-read path, and the SQL-free LedgerTxnRoot read mode.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from stellar_core_tpu.bucket.bucket_list import Bucket, BucketList
+from stellar_core_tpu.bucket.disk_bucket import DiskBucket, _sidecar_path
+from stellar_core_tpu.bucket.index import (
+    BloomFilter, DICT_MAX, MemBucketIndex, load_disk_index,
+    read_sidecar_bloom, sidecar_bloom_offset,
+)
+from stellar_core_tpu.ledger.ledger_txn import (
+    LedgerTxn, entry_to_key, key_bytes,
+)
+from stellar_core_tpu.transactions import utils as U
+
+
+def _entry(i: int, balance=None):
+    e = U.make_account_entry(i.to_bytes(4, "big") * 8,
+                             balance if balance is not None
+                             else 10_000_000 + i)
+    return key_bytes(entry_to_key(e)), e
+
+
+def _changes(lo, hi):
+    return [(kb, e, False) for kb, e in (_entry(i) for i in range(lo, hi))]
+
+
+# -- bloom filter ------------------------------------------------------------
+
+def test_bloom_native_python_bit_identical():
+    keys = [b"key-%05d" % i for i in range(3000)]
+    py = BloomFilter.build(keys)  # pure-python loop
+    klen = np.array([len(k) for k in keys], np.int32)
+    koff = np.zeros(len(keys), np.int64)
+    np.cumsum(klen[:-1], out=koff[1:])
+    nat = BloomFilter.build_from_table(b"".join(keys), koff, klen)
+    assert (py.words == nat.words).all()
+
+
+def test_bloom_no_false_negatives_and_low_fpr():
+    keys = [b"present-%06d" % i for i in range(10_000)]
+    bf = BloomFilter.build(keys)
+    assert all(bf.may_contain(k) for k in keys)
+    misses = sum(bf.may_contain(b"absent-%06d" % i) for i in range(20_000))
+    assert misses / 20_000 < 0.05  # blocked bloom at ~10.7 bits/key
+
+
+def test_bloom_round_trip():
+    bf = BloomFilter.build([b"a", b"bb", b"ccc"])
+    rt = BloomFilter.from_bytes(bf.to_bytes())
+    assert (rt.words == bf.words).all()
+    assert BloomFilter.from_bytes(b"garbage") is None
+
+
+# -- in-memory index ---------------------------------------------------------
+
+def test_mem_index_exact_dict_and_bloom_shapes():
+    entries = [_entry(i) for i in range(100)]
+    b = Bucket([(kb, _mk_live(e)) for kb, e in entries])
+    idx = b.ensure_index()
+    assert isinstance(idx, MemBucketIndex)
+    for kb, e in entries:
+        assert idx.may_contain(kb)
+        assert idx.find(b, kb) is not None
+    absent = _entry(5000)[0]
+    assert not idx.may_contain(absent)
+    # the large shape: force the bloom+bisect branch via DICT_MAX
+    keys = tuple(kb for kb, _ in entries)
+    big = MemBucketIndex.__new__(MemBucketIndex)
+    big._pos = None
+    big.bloom = BloomFilter.build(keys)
+    assert all(big.may_contain(kb) for kb in keys)
+    assert big.find(b, keys[3]) is not None
+    assert big.find(b, absent) is None
+    assert DICT_MAX >= 1024  # small test buckets stay on the dict path
+
+
+def _mk_live(e):
+    from stellar_core_tpu.xdr import types as T
+
+    return T.BucketEntry.make(T.BucketEntryType.LIVEENTRY, e)
+
+
+# -- disk index --------------------------------------------------------------
+
+@pytest.fixture()
+def disk_bucket(tmp_path):
+    entries = [(kb, _mk_live(e)) for kb, e in
+               sorted(_entry(i) for i in range(500))]
+    return DiskBucket.from_entries(str(tmp_path), iter(entries)), entries
+
+
+def test_disk_bucket_index_exact_lookup(disk_bucket):
+    db, entries = disk_bucket
+    idx = db.ensure_index()
+    assert idx is not None and idx.count == 500
+    for kb, e in entries[::17]:
+        assert idx.may_contain(kb)
+        got = db.get(kb)
+        assert got is not None and got.value == e.value
+    assert db.get(_entry(10_000)[0]) is None
+
+
+def test_disk_index_persisted_and_memmapped(disk_bucket, tmp_path):
+    db, entries = disk_bucket
+    sp = _sidecar_path(db.path)
+    assert sidecar_bloom_offset(sp) is not None
+    assert read_sidecar_bloom(sp) is not None
+    idx = load_disk_index(sp, db.count)
+    assert idx is not None
+    # memmapped arrays: resident cost is just the bloom words
+    assert idx.resident_bytes == idx.bloom.nbytes
+    kb = entries[123][0]
+    assert idx.entry_span(kb) is not None
+    # reopen (restart path): index reloads from the persisted sidecar
+    db2 = DiskBucket.open(db.path, db.hash())
+    assert db2.ensure_index() is not None
+    assert db2.get(kb).value == entries[123][1].value
+
+
+def test_legacy_sidecar_upgrades_in_place(disk_bucket):
+    """A PR-1 sidecar (entry table, no bloom section) is upgraded the
+    first time an index is requested."""
+    db, entries = disk_bucket
+    sp = _sidecar_path(db.path)
+    off = sidecar_bloom_offset(sp)
+    with open(sp, "rb") as f:
+        legacy = f.read(off)  # strip the bloom section
+    with open(sp, "wb") as f:
+        f.write(legacy)
+    assert read_sidecar_bloom(sp) is None
+    db2 = DiskBucket.open(db.path, db.hash())
+    idx = db2.ensure_index()
+    assert idx is not None
+    assert read_sidecar_bloom(sp) is not None  # persisted back
+    assert db2.get(entries[7][0]) is not None
+
+
+def test_batch_lower_bound_matches_scalar(disk_bucket):
+    db, entries = disk_bucket
+    idx = db.ensure_index()
+    probes = [kb for kb, _ in entries[::13]] + [b"\x00", b"\xff" * 40]
+    batch = idx.positions_batch(probes)
+    for kb, pos in zip(probes, batch):
+        assert idx.position(kb) == int(pos)
+
+
+# -- bucket list read path ---------------------------------------------------
+
+def test_point_reads_probe_one_bucket_not_all(tmp_path):
+    from concurrent.futures import ThreadPoolExecutor
+
+    ex = ThreadPoolExecutor(max_workers=2)
+    bl = BucketList(executor=ex, disk_dir=str(tmp_path), disk_level=2)
+    seq = 1
+    for batch in range(16):
+        seq += 1
+        bl.add_batch(seq, _changes(batch * 250, (batch + 1) * 250))
+    ex.shutdown(wait=True)
+    n_buckets = sum(1 for _ in bl._buckets_shallow_first())
+    assert n_buckets >= 4
+    base = dict(bl.stats)
+    for i in range(0, 4000, 29):
+        kb, e = _entry(i)
+        got = bl.get_entry(kb)
+        assert got is not None and got.data.value.balance == \
+            e.data.value.balance
+    reads = bl.stats["point_reads"] - base["point_reads"]
+    probes = bl.stats["bucket_probes"] - base["bucket_probes"]
+    assert probes / reads < 1.5  # bloom-first: ~1 probe per read
+    # linear scan for comparison: probes grow with bucket count
+    bl.index_enabled = False
+    base = dict(bl.stats)
+    for i in range(0, 4000, 29):
+        assert bl.get_entry(_entry(i)[0]) is not None
+    lin_probes = bl.stats["bucket_probes"] - base["bucket_probes"]
+    lin_reads = bl.stats["point_reads"] - base["point_reads"]
+    assert lin_probes / lin_reads > 2 * (probes / reads)
+
+
+def test_get_entries_matches_get_entry(tmp_path):
+    bl = BucketList(disk_dir=str(tmp_path), disk_level=2)
+    seq = 1
+    for batch in range(8):
+        seq += 1
+        bl.add_batch(seq, _changes(batch * 200, (batch + 1) * 200))
+    probes = [_entry(i)[0] for i in range(0, 2000, 7)]
+    batch_res = bl.get_entries(probes)
+    for kb in probes:
+        assert batch_res[kb] == bl.get_entry(kb)
+    # deleted entries answer None from both paths
+    kb_dead, e_dead = _entry(3)
+    bl.add_batch(seq + 1, [(kb_dead, None, True)])
+    assert bl.get_entry(kb_dead) is None
+    assert bl.get_entries([kb_dead])[kb_dead] is None
+
+
+def test_index_does_not_change_hash_chain(tmp_path):
+    def run(indexed):
+        bl = BucketList(disk_dir=str(tmp_path / ("i" if indexed else "n")),
+                        disk_level=2)
+        bl.index_enabled = indexed
+        hashes = []
+        for batch in range(8):
+            hashes.append(bl.add_batch(
+                batch + 2, _changes(batch * 100, (batch + 1) * 100)))
+        for i in range(0, 800, 11):
+            bl.get_entry(_entry(i)[0])
+        hashes.append(bl.hash())
+        return hashes
+
+    assert run(True) == run(False)
+
+
+# -- LedgerTxnRoot BucketListDB mode ----------------------------------------
+
+def _node():
+    from stellar_core_tpu.main import Application, test_config
+    from stellar_core_tpu.main.http_server import CommandHandler
+    from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), test_config())
+    app.start()
+    handler = CommandHandler(app)
+    code, body = handler.handle("generateload",
+                                {"mode": "create", "accounts": "20"})
+    assert code == 200, body
+    app.herder.manual_close()
+    return app
+
+
+def test_root_point_reads_skip_sql():
+    app = _node()
+    root = app.ledger_manager.root
+    assert root.bucket_reads_enabled
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+
+    kbs = [key_bytes(entry_to_key(U.make_account_entry(
+        LoadGenerator.account_key(i).public_key().raw, 0)))
+        for i in range(20)]
+    root.clear_entry_cache()
+    q0 = app.database.queries
+    b0 = root.reads_from_buckets
+    for kb in kbs:
+        assert root.get(kb) is not None
+    assert app.database.queries == q0, "point reads must not touch SQL"
+    assert root.reads_from_buckets - b0 == len(kbs)
+    # negative lookups are SQL-free too
+    absent = key_bytes(entry_to_key(U.make_account_entry(b"\xfe" * 32, 0)))
+    assert root.get(absent) is None
+    assert app.database.queries == q0
+    # prefetch feeds from the bucket tier in one batch
+    root.clear_entry_cache()
+    q0 = app.database.queries
+    assert root.prefetch(kbs) == len(kbs)
+    assert app.database.queries == q0
+
+
+def test_root_bucket_reads_match_sql_reads():
+    app = _node()
+    root = app.ledger_manager.root
+    rows = app.database.execute(
+        "SELECT key FROM ledgerentries").fetchall()
+    assert rows
+    from stellar_core_tpu.xdr import types as T
+
+    for (kb,) in rows:
+        via_bucket = root.get(kb)
+        row = app.database.execute(
+            "SELECT entry FROM ledgerentries WHERE key = ?",
+            (kb,)).fetchone()
+        via_sql = T.LedgerEntry.decode(row[0])
+        assert via_bucket == via_sql, kb.hex()
+
+
+def test_direct_commits_visible_via_overlay():
+    """Writes that bypass the close path (test-rig bulk seeding) never
+    reach the buckets; the sql-ahead overlay must keep them readable."""
+    app = _node()
+    root = app.ledger_manager.root
+    kb, e = _entry(990_001)
+    with LedgerTxn(root) as ltx:
+        ltx.put(e)
+        ltx.commit()
+    root._entry_cache.clear()  # drop the write-through cache copy only
+    got = root.get(kb)
+    assert got is not None
+    assert root.reads_from_overlay > 0
+    # after the NEXT close touches the key, buckets serve it
+    assert kb in root._sql_ahead
+
+
+def test_bucket_reads_gated_on_restore(tmp_path):
+    """A restarted node only serves bucket reads when the restored list
+    hash-verifies; without a bucket store it stays on SQL."""
+    from stellar_core_tpu.main import Application, test_config
+    from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+
+    from stellar_core_tpu.main.http_server import CommandHandler
+
+    db = str(tmp_path / "node.db")
+    bdir = str(tmp_path / "buckets")
+    cfg = dict(DATABASE=db, BUCKET_DIR_PATH_REAL=bdir)
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME),
+                      test_config(**cfg))
+    app.start()
+    handler = CommandHandler(app)
+    code, body = handler.handle("generateload",
+                                {"mode": "create", "accounts": "5"})
+    assert code == 200, body
+    app.herder.manual_close()
+    app.graceful_stop()
+    app.database.close()
+
+    # restart WITH the bucket store: hash-verified restore -> bucket reads
+    app2 = Application(VirtualClock(ClockMode.VIRTUAL_TIME),
+                       test_config(**cfg))
+    app2.start()
+    root2 = app2.ledger_manager.root
+    assert root2.bucket_reads_enabled
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+
+    kb = key_bytes(entry_to_key(U.make_account_entry(
+        LoadGenerator.account_key(0).public_key().raw, 0)))
+    q0 = app2.database.queries
+    assert root2.get(kb) is not None  # served from restored buckets
+    assert app2.database.queries == q0
+    app2.graceful_stop()
+    app2.database.close()
+
+    # restart WITHOUT a bucket store configured: the bucket list cannot
+    # be restored, SQL keeps serving (bucket reads stay gated off)
+    app3 = Application(VirtualClock(ClockMode.VIRTUAL_TIME),
+                       test_config(DATABASE=db))
+    app3.start()
+    root3 = app3.ledger_manager.root
+    assert not root3.bucket_reads_enabled
+    q0 = app3.database.queries
+    assert root3.get(kb) is not None
+    assert app3.database.queries > q0  # SQL path
+    app3.graceful_stop()
+
+
+def test_restart_keeps_sql_only_entries_readable(tmp_path):
+    """The genesis root account is a direct (non-close) commit; with only
+    EMPTY closes it never enters the buckets.  A restart must keep it
+    readable in BucketListDB mode — the sql-ahead overlay's key list is
+    persisted with the bucket state and reloaded on boot."""
+    from stellar_core_tpu.crypto import SecretKey
+    from stellar_core_tpu.main import Application, test_config
+    from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+
+    cfg = dict(DATABASE=str(tmp_path / "n.db"),
+               BUCKET_DIR_PATH_REAL=str(tmp_path / "b"))
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME),
+                      test_config(**cfg))
+    app.start()
+    root_kb = key_bytes(entry_to_key(U.make_account_entry(
+        SecretKey(app.config.network_id()).public_key().raw, 0)))
+    app.herder.manual_close()  # empty close: nothing folds into buckets
+    app.herder.manual_close()
+    assert root_kb in app.ledger_manager.root._sql_ahead
+    app.graceful_stop()
+    app.database.close()
+
+    app2 = Application(VirtualClock(ClockMode.VIRTUAL_TIME),
+                       test_config(**cfg))
+    app2.start()
+    root2 = app2.ledger_manager.root
+    assert root2.bucket_reads_enabled
+    assert root_kb in root2._sql_ahead
+    got = root2.get(root_kb)
+    assert got is not None and got.data.value.balance > 0
+    # and the node can actually accept a root-sourced tx after restart
+    from tests.test_standalone_node import root_account
+
+    env = root_account(app2).tx([root_account(app2).op_create_account(
+        SecretKey(b"\x11" * 32).public_key().raw, 10**9)])
+    assert app2.herder.recv_transaction(env) == 0
+    app2.herder.manual_close()
+    app2.graceful_stop()
+
+
+def test_bucketlist_db_config_off_keeps_sql():
+    from stellar_core_tpu.main import Application, test_config
+    from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME),
+                      test_config(BUCKETLIST_DB=False))
+    app.start()
+    root = app.ledger_manager.root
+    assert not root.bucket_reads_enabled
+    q0 = app.database.queries
+    root.get(b"\x00" * 8)
+    assert app.database.queries > q0

@@ -1,0 +1,237 @@
+#!/usr/bin/env python
+"""BucketListDB read-path evidence (ISSUE r7 acceptance artifact): a
+1M-entry disk-tier BucketList serves point reads through the per-bucket
+bloom-filtered indexes, and the numbers prove
+
+- >=10x fewer bucket probes per point read than the linear-scan
+  baseline (the same list with index_enabled=False),
+- zero SQL queries on the point-lookup path (LedgerTxnRoot in
+  BucketListDB mode, measured on a live node),
+- a bucket-list hash bit-identical between an indexed and an unindexed
+  build of the same workload,
+- index build cost per close (index_build_s) small against close p50.
+
+Schema follows BUCKET_SCALE_r06.json.  Writes READ_BENCH_r07.json.
+
+Usage: python tools/read_bench.py [n_entries] [per_close] [n_reads]
+"""
+import json
+import os
+import resource
+import statistics
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def rss_mb():
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+
+
+def build_list(n_entries, per_close, tmp, indexed=True):
+    from concurrent.futures import ThreadPoolExecutor
+
+    from stellar_core_tpu.bucket.bucket_list import BucketList
+    from stellar_core_tpu.ledger.ledger_txn import entry_to_key, key_bytes
+    from stellar_core_tpu.transactions import utils as U
+
+    executor = ThreadPoolExecutor(max_workers=2,
+                                  thread_name_prefix="bucket-merge")
+    bl = BucketList(executor=executor, disk_dir=tmp, disk_level=2)
+    bl.index_enabled = indexed
+    close_times = []
+    seq = 1
+    made = 0
+    while made < n_entries:
+        seq += 1
+        changes = []
+        for j in range(min(per_close, n_entries - made)):
+            i = made + j
+            e = U.make_account_entry(
+                i.to_bytes(4, "big") * 8, 10_000_000 + i)
+            changes.append((key_bytes(entry_to_key(e)), e, False))
+        made += len(changes)
+        t0 = time.perf_counter()
+        bl.add_batch(seq, changes)
+        close_times.append(time.perf_counter() - t0)
+        if seq % 50 == 0:
+            print(f"[build indexed={indexed}] seq {seq}: {made} entries, "
+                  f"rss {rss_mb():.0f}MB", flush=True)
+    executor.shutdown(wait=True)
+    return bl, close_times
+
+
+def sample_keys(n_entries, n_reads):
+    from stellar_core_tpu.ledger.ledger_txn import entry_to_key, key_bytes
+    from stellar_core_tpu.transactions import utils as U
+
+    present = []
+    step = max(1, n_entries // (n_reads * 4 // 5))
+    for i in range(0, n_entries, step):
+        present.append(key_bytes(entry_to_key(U.make_account_entry(
+            i.to_bytes(4, "big") * 8, 1))))
+        if len(present) >= n_reads * 4 // 5:
+            break
+    absent = [key_bytes(entry_to_key(U.make_account_entry(
+        (0x7F000000 + i).to_bytes(4, "big") * 8, 1)))
+        for i in range(n_reads - len(present))]
+    return present, absent
+
+
+def measure_reads(bl, present, absent, label):
+    base = dict(bl.stats)
+    lat = []
+    for kb in present:
+        t0 = time.perf_counter()
+        e = bl.get_entry(kb)
+        lat.append(time.perf_counter() - t0)
+        assert e is not None, kb.hex()
+    for kb in absent:
+        t0 = time.perf_counter()
+        e = bl.get_entry(kb)
+        lat.append(time.perf_counter() - t0)
+        assert e is None
+    reads = bl.stats["point_reads"] - base["point_reads"]
+    probes = bl.stats["bucket_probes"] - base["bucket_probes"]
+    checks = bl.stats["bloom_checks"] - base["bloom_checks"]
+    fps = bl.stats["bloom_false_positives"] - base["bloom_false_positives"]
+    lat.sort()
+    out = {
+        "reads": reads,
+        "probes": probes,
+        "probes_per_read": round(probes / reads, 4),
+        "bloom_false_positive_rate": round(fps / checks, 6) if checks
+        else 0.0,
+        "read_us_p50": round(lat[len(lat) // 2] * 1e6, 1),
+        "read_us_p99": round(lat[int(len(lat) * 0.99)] * 1e6, 1),
+    }
+    print(f"[{label}] {json.dumps(out)}", flush=True)
+    return out
+
+
+def sql_free_node_check():
+    """A live node in BucketListDB mode: point lookups + prefetch issue
+    ZERO SQL queries (measured on the Database wrapper's query counter)."""
+    from stellar_core_tpu.ledger.ledger_txn import entry_to_key, key_bytes
+    from stellar_core_tpu.main import Application, test_config
+    from stellar_core_tpu.main.http_server import CommandHandler
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+    from stellar_core_tpu.transactions import utils as U
+    from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), test_config())
+    app.start()
+    handler = CommandHandler(app)
+    # one 100-op batch tx per close: the default tx-set cap is 100 ops
+    code, body = handler.handle("generateload",
+                                {"mode": "create", "accounts": "100"})
+    assert code == 200 and body["status_counts"] == {0: 1}, body
+    app.herder.manual_close()
+    code, body = handler.handle("generateload",
+                                {"mode": "pay", "txs": "100"})
+    assert code == 200 and body["status_counts"] == {0: 100}, body
+    app.herder.manual_close()
+    root = app.ledger_manager.root
+    assert root.bucket_reads_enabled
+    kbs = [key_bytes(entry_to_key(U.make_account_entry(
+        LoadGenerator.account_key(i).public_key().raw, 0)))
+        for i in range(100)]
+    root._entry_cache.clear()
+    q0 = app.database.queries
+    for kb in kbs:
+        assert root.get(kb) is not None
+    root._entry_cache.clear()
+    root.prefetch(kbs)
+    sql_queries = app.database.queries - q0
+    served = {"bucket": root.reads_from_buckets,
+              "overlay": root.reads_from_overlay,
+              "sql": root.reads_from_sql}
+    app.graceful_stop()
+    return sql_queries, served
+
+
+def main():
+    n_entries = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    per_close = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
+    n_reads = int(sys.argv[3]) if len(sys.argv) > 3 else 20_000
+
+    import shutil
+
+    # indexed build + reads
+    tmp = tempfile.mkdtemp(prefix="read-bench-")
+    t0 = time.time()
+    bl, close_times = build_list(n_entries, per_close, tmp, indexed=True)
+    build_s = time.time() - t0
+    present, absent = sample_keys(n_entries, n_reads)
+    indexed = measure_reads(bl, present, absent, "indexed")
+    indexed_hash = bl.hash().hex()
+    index_build_s = bl.stats["index_build_s"]
+    index_mem = bl.index_memory_bytes()
+    n_buckets = sum(1 for _ in bl._buckets_shallow_first())
+
+    # linear-scan baseline on the SAME list (fewer reads: each one scans
+    # every bucket), then a full unindexed REBUILD for hash parity
+    bl.index_enabled = False
+    lin_reads = max(200, n_reads // 20)
+    linear = measure_reads(bl, present[:lin_reads * 4 // 5],
+                           absent[:lin_reads // 5], "linear")
+    del bl
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    tmp2 = tempfile.mkdtemp(prefix="read-bench-noidx-")
+    bl2, close_times_noidx = build_list(n_entries, per_close, tmp2,
+                                        indexed=False)
+    unindexed_hash = bl2.hash().hex()
+    del bl2
+    shutil.rmtree(tmp2, ignore_errors=True)
+
+    sql_queries, served = sql_free_node_check()
+
+    closes = len(close_times)
+    out = {
+        "n_entries": n_entries,
+        "per_close": per_close,
+        "closes": closes,
+        "build_seconds": round(build_s, 1),
+        "close_ms_p50": round(statistics.median(close_times) * 1000, 1),
+        "close_ms_max": round(max(close_times) * 1000, 1),
+        "close_ms_p50_noindex": round(
+            statistics.median(close_times_noidx) * 1000, 1),
+        "index_build_ms_per_close": round(
+            index_build_s * 1000 / closes, 3),
+        "index_memory_bytes": index_mem,
+        "live_buckets": n_buckets,
+        "point_reads": indexed["reads"],
+        "read_us_p50": indexed["read_us_p50"],
+        "read_us_p99": indexed["read_us_p99"],
+        "probes_per_read": indexed["probes_per_read"],
+        "bloom_false_positive_rate":
+            indexed["bloom_false_positive_rate"],
+        "linear_probes_per_read": linear["probes_per_read"],
+        "linear_read_us_p50": linear["read_us_p50"],
+        "probe_reduction_x": round(
+            linear["probes_per_read"] / indexed["probes_per_read"], 1),
+        "sql_queries_point_lookup": sql_queries,
+        "point_reads_served_by": served,
+        "bucket_hash_indexed": indexed_hash,
+        "bucket_hash_unindexed": unindexed_hash,
+        "hash_bit_identical": indexed_hash == unindexed_hash,
+        "rss_mb_peak": round(rss_mb(), 1),
+    }
+    with open(os.path.join(REPO, "READ_BENCH_r07.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    assert out["hash_bit_identical"], "index changed the bucket hash!"
+    assert out["sql_queries_point_lookup"] == 0, "SQL on the point path"
+    # the >=10x probe-reduction acceptance bar applies at the 1M-entry
+    # artifact scale; toy validation runs have too few buckets to scan
+    if n_entries >= 500_000:
+        assert out["probe_reduction_x"] >= 10, "probe reduction below 10x"
+
+
+if __name__ == "__main__":
+    main()

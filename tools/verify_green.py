@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""The snapshot-must-be-green gate (VERDICT r5; ISSUE r7 satellite): run
+the tier-1 command EXACTLY as ROADMAP.md states it and exit nonzero on
+any test failure OR collection error.
+
+The tier-1 command is parsed out of ROADMAP.md (single source of truth:
+the driver, the builder and this gate all run the same line).  pytest's
+exit code already covers failures; collection errors are additionally
+grepped out of the log because `--continue-on-collection-errors` can
+leave a "green-looking" run that silently skipped whole files.
+
+Usage: python tools/verify_green.py        -> exit 0 iff green
+"""
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def tier1_command() -> str:
+    text = open(os.path.join(REPO, "ROADMAP.md")).read()
+    m = re.search(r"\*\*Tier-1 verify:\*\* `(.+?)`", text, re.S)
+    if not m:
+        print("verify_green: no tier-1 command found in ROADMAP.md",
+              file=sys.stderr)
+        sys.exit(2)
+    return m.group(1)
+
+
+def main() -> int:
+    cmd = tier1_command()
+    print(f"verify_green: {cmd}", flush=True)
+    proc = subprocess.run(["bash", "-c", cmd], cwd=REPO)
+    rc = proc.returncode
+    problems = []
+    if rc != 0:
+        problems.append(f"tier-1 command exited {rc}")
+    try:
+        with open("/tmp/_t1.log", errors="replace") as f:
+            log = f.read()
+    except OSError:
+        problems.append("tier-1 log /tmp/_t1.log missing")
+        log = ""
+    # the summary line: "N passed", "N failed", "N errors" — failures
+    # and errors both break the gate even if the shell rc lied
+    tail = "\n".join(log.splitlines()[-30:])
+    for pat, what in ((r"\b([1-9]\d*) failed\b", "failed tests"),
+                      (r"\b([1-9]\d*) errors?\b", "collection errors")):
+        m = re.search(pat, tail)
+        if m:
+            problems.append(f"{m.group(1)} {what}")
+    if re.search(r"^=+ ERRORS =+$", log, re.M):
+        problems.append("ERRORS section in pytest output")
+    m = re.search(r"\b(\d+) passed\b", tail)
+    passed = m.group(1) if m else "?"
+    if problems:
+        print(f"verify_green: RED ({'; '.join(problems)}); "
+              f"passed={passed}", flush=True)
+        return 1
+    print(f"verify_green: GREEN (passed={passed})", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
