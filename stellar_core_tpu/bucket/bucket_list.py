@@ -396,7 +396,7 @@ class BucketList:
         import threading as _threading
 
         self._bg_lock = _threading.Lock()
-        self._bg_outputs: set = set()
+        self._bg_outputs: set = set()  # guarded-by: _bg_lock
         # merge-pipeline observability (surfaced via /metrics and bench):
         # sync_fallback_merges MUST stay 0 in steady state — it counts
         # closes that had to run a non-trivial merge inline
@@ -867,7 +867,7 @@ class BucketManager:
         xdr_names = {n for n in names
                      if n.startswith("bucket-") and n.endswith(".xdr")}
         candidates = set()
-        for name in xdr_names:
+        for name in sorted(xdr_names):
             hh = name[len("bucket-"):-len(".xdr")]
             if hh in live:
                 continue

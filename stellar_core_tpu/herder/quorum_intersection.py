@@ -339,6 +339,10 @@ class _MinQuorumEnumerator:
             if self.deadline is not None:
                 import time as _time
 
+                # scan-budget cutoff only: an expired deadline aborts
+                # with an explicit "exhausted" verdict, never silently
+                # changes an intersection answer
+                # detlint: allow(det-wallclock)
                 if _time.monotonic() > self.deadline:
                     raise _BudgetExhausted(self.calls)
             batch = stack[-BATCH:]
@@ -527,7 +531,7 @@ def _try_org_reduction(main_scc: List[bytes], qmap: Dict[bytes, object]):
     # orgs = the distinct inner sets; must partition the universe with one
     # consistent threshold each
     org_thr: Dict[frozenset, int] = {}
-    for thr, inners in plains.values():
+    for _, (thr, inners) in sorted(plains.items()):
         for t, fs in inners:
             if org_thr.setdefault(fs, t) != t:
                 return None
@@ -570,6 +574,7 @@ def _native_call_cap(max_calls: int, deadline) -> int:
 
     if deadline is None:
         return max_calls
+    # detlint: allow(det-wallclock) — wall budget, not consensus data
     remaining = max(0.0, deadline - _time.monotonic())
     time_cap = max(1, int(remaining * 1_000_000))
     return min(max_calls or time_cap, time_cap)
@@ -686,6 +691,7 @@ def check_quorum_intersection(qmap: Dict[bytes, object],
 
     import time as _time
 
+    # detlint: allow(det-wallclock) — scan timeout budget, not consensus
     deadline = (_time.monotonic() + max_seconds
                 if max_seconds is not None else None)
     try:

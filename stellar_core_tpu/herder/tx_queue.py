@@ -129,10 +129,14 @@ class TransactionQueue:
         src = frame.source_account_id()
         tails = []  # planned victims, cheapest first, per-account tails
         depth: Dict[bytes, int] = {}
+        # sorted once outside the planning loop (accounts don't change
+        # until eviction below): victim ties must break by account id,
+        # not by arrival/hash order (detlint det-unsorted-iter)
+        accounts_by_id = sorted(self.accounts.items())
         while shortfall > 0:
             victim_src = None
             victim = None
-            for vsrc, acct in self.accounts.items():
+            for vsrc, acct in accounts_by_id:
                 if vsrc == src:
                     continue  # never break the newcomer's own chain
                 idx = len(acct.frames) - 1 - depth.get(vsrc, 0)

@@ -14,6 +14,7 @@ hot path P5 (SURVEY.md §2.17).
 from __future__ import annotations
 
 import hashlib
+from fractions import Fraction
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..crypto import sha256
@@ -136,7 +137,7 @@ class TxSetFrame:
             by_source.setdefault(f.source_account_id(), []).append(f)
         with LedgerTxn(ltx_root) as ltx:
             ok = True
-            for source, fs in by_source.items():
+            for source, fs in sorted(by_source.items()):
                 fs.sort(key=lambda f: f.seq_num())
                 entry = ltx.load_account(source)
                 if entry is None:
@@ -171,7 +172,7 @@ class TxSetFrame:
         by_source: Dict[bytes, List[TransactionFrame]] = {}
         for f in self.frames:
             by_source.setdefault(f.source_account_id(), []).append(f)
-        for fs in by_source.values():
+        for _, fs in sorted(by_source.items()):
             fs.sort(key=lambda f: f.seq_num())
         iters = {src: iter(fs) for src, fs in by_source.items()}
         return [next(iters[f.source_account_id()]) for f in shuffled]
@@ -208,7 +209,7 @@ class TxSetFrame:
                 keys.add(opf.source_account_id())
             for h, sigs in payloads:
                 for i, ds in enumerate(sigs):
-                    for pub in keys:
+                    for pub in sorted(keys):
                         if ds.hint == signature_hint(pub):
                             triples.append((pub, ds.signature, h))
                             index.append((fi, i, pub))
@@ -229,7 +230,10 @@ class TxSetFrame:
 
             # kernel tier: the XLA kernel lowers on every backend and is
             # the safe default; CRYPTO_KERNEL=pallas opts the node into
-            # the Pallas TPU kernel (bench.py probes pallas itself)
+            # the Pallas TPU kernel (bench.py probes pallas itself).
+            # Kernel CHOICE is env-driven but both tiers return
+            # bit-identical verdicts, so this read is consensus-neutral.
+            # detlint: allow(det-wallclock)
             if os.environ.get("CRYPTO_KERNEL", "xla") == "pallas":
                 from ..ops.ed25519_pallas import verify_batch
             else:
@@ -288,13 +292,16 @@ def surge_pricing_filter(frames: List[TransactionFrame],
         return list(frames)
 
     def rate(f: TransactionFrame) -> Tuple:
-        # fee-per-op, tie-break by hash for determinism
-        return (-f.fee_bid() / max(1, f.num_operations()), f.full_hash())
+        # fee-per-op as an EXACT rational (float division could tie or
+        # flip near-equal rates after rounding — consensus-visible
+        # ordering must be exact int math); tie-break by hash
+        return (Fraction(-f.fee_bid(), max(1, f.num_operations())),
+                f.full_hash())
 
     by_source: Dict[bytes, List[TransactionFrame]] = {}
     for f in frames:
         by_source.setdefault(f.source_account_id(), []).append(f)
-    for fs in by_source.values():
+    for _, fs in sorted(by_source.items()):
         fs.sort(key=lambda f: f.seq_num())
 
     kept: set = set()

@@ -15,6 +15,7 @@ model instead of the reference's entry-activation machinery):
 """
 from __future__ import annotations
 
+from fractions import Fraction
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from ..xdr import types as T
@@ -376,11 +377,6 @@ class LedgerTxn(AbstractLedgerTxn):
 _OFFER_PREFIX = T.LedgerEntryType.encode(T.LedgerEntryType.OFFER)
 
 
-def _offer_order_key(entry) -> Tuple[float, int]:
-    o = entry.data.value
-    return (o.price.n / o.price.d, o.offerID)
-
-
 class LedgerTxnRoot(AbstractLedgerTxn):
     """Root layer: entry store + header.  Point reads are served from the
     bucket tier when BucketListDB mode is enabled (ref BucketListDB /
@@ -548,7 +544,7 @@ class LedgerTxnRoot(AbstractLedgerTxn):
     def _commit_from_child(self, delta: Dict[bytes, Optional[object]],
                            header) -> None:
         cur = self.db.cursor()
-        for kb, entry in delta.items():
+        for kb, entry in sorted(delta.items()):
             if kb.startswith(VIRTUAL_PREFIX):
                 if entry is not None:
                     raise LedgerTxnError(
@@ -578,6 +574,12 @@ class LedgerTxnRoot(AbstractLedgerTxn):
                     (kb, et, eb))
                 if et == T.LedgerEntryType.OFFER:
                     o = entry.data.value
+                    # the REAL price column is an INDEX approximation
+                    # (ORDER BY prefilter); exact pricen/priced ride
+                    # alongside and _best_offer re-compares float ties
+                    # exactly
+                    # detlint: allow(det-float-consensus)
+                    price_approx = o.price.n / o.price.d
                     cur.execute(
                         "INSERT INTO offers(key, sellerid, offerid, "
                         "selling, buying, price, pricen, priced, amount) "
@@ -588,7 +590,7 @@ class LedgerTxnRoot(AbstractLedgerTxn):
                         "amount=excluded.amount",
                         (kb, o.sellerID.value, o.offerID,
                          T.Asset.encode(o.selling), T.Asset.encode(o.buying),
-                         o.price.n / o.price.d, o.price.n, o.price.d,
+                         price_approx, o.price.n, o.price.d,
                          o.amount))
         if header is not None:
             hb = T.LedgerHeader.encode(header)
@@ -605,26 +607,40 @@ class LedgerTxnRoot(AbstractLedgerTxn):
                     overrides: Dict[bytes, Optional[object]],
                     worse_than=None):
         """Lowest-price offer for the pair, merging the SQL index with the
-        uncommitted overrides.  worse_than: (price_float, offerID) exclusive
-        lower bound for iteration."""
+        uncommitted overrides.  worse_than: (Fraction-price, offerID)
+        exclusive lower bound for iteration.
+
+        Price comparisons are EXACT rationals (Fraction): the REAL
+        ``price`` column only prefilters the SQL scan, so two distinct
+        rationals colliding in double precision cannot flip the crossing
+        order — the float tie-run is re-compared exactly below."""
         candidates = []
         q = ("SELECT key, pricen, priced, offerid FROM offers "
              "WHERE selling = ? AND buying = ? ORDER BY price, offerid")
+        first_tie = None  # float price of the first unshadowed row
         for kb, pn, pd, oid in self.db.execute(q, (selling, buying)):
             if kb in overrides:
                 continue  # shadowed by the open txn
-            if worse_than is not None and (pn / pd, oid) <= worse_than:
+            key = (Fraction(pn, pd), oid)
+            if worse_than is not None and key <= worse_than:
                 continue
-            candidates.append((pn / pd, oid, kb))
-            break  # SQL rows are sorted; first unshadowed row wins
-        for kb, e in overrides.items():
+            # collect the whole run of rows tied at the first float
+            # price — exact order may disagree inside the tie
+            # detlint: allow(det-float-consensus)
+            approx = pn / pd
+            if first_tie is None:
+                first_tie = approx
+            elif approx != first_tie:
+                break  # beyond the tie-run: float order is exact order
+            candidates.append((*key, kb))
+        for kb, e in sorted(overrides.items()):
             if e is None:
                 continue
             o = e.data.value
             if (T.Asset.encode(o.selling) != selling
                     or T.Asset.encode(o.buying) != buying):
                 continue
-            key = (o.price.n / o.price.d, o.offerID)
+            key = (Fraction(o.price.n, o.price.d), o.offerID)
             if worse_than is not None and key <= worse_than:
                 continue
             candidates.append((*key, kb))

@@ -21,8 +21,8 @@ _SRCS = [os.path.join(_DIR, f) for f in ("bucket_merge.cpp",
                                          "quorum_enum.cpp")]
 
 _lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_tried = False
+_lib: Optional[ctypes.CDLL] = None  # guarded-by: _lock
+_tried = False  # guarded-by: _lock
 
 
 def _build() -> bool:
@@ -134,8 +134,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
 
 _XDRPACK_SRC = os.path.join(_DIR, "xdr_pack.c")
 _XDRPACK_SO = os.path.join(_DIR, "_xdrpack.so")
-_xdrpack_mod = None
-_xdrpack_tried = False
+_xdrpack_mod = None  # guarded-by: _lock
+_xdrpack_tried = False  # guarded-by: _lock
 
 
 def get_xdrpack(build: bool = True):

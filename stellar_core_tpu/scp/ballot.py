@@ -315,7 +315,7 @@ class BallotProtocol:
         candidates: Set[Ballot] = set()
         for top_vote in sorted(hint_ballots, reverse=True):
             val = top_vote[1]
-            for env in self.latest_envelopes.values():
+            for _, env in sorted(self.latest_envelopes.items()):
                 st = env.statement
                 t2 = pledge_type(st)
                 p2 = st.pledges.value
@@ -475,7 +475,7 @@ class BallotProtocol:
     # step 5-6: accept commit
     def _get_commit_boundaries(self, ballot: Ballot) -> List[int]:
         res: Set[int] = set()
-        for env in self.latest_envelopes.values():
+        for _, env in sorted(self.latest_envelopes.items()):
             st = env.statement
             t = pledge_type(st)
             p = st.pledges.value

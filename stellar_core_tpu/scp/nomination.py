@@ -178,8 +178,9 @@ class NominationProtocol:
                         self.votes.add(to_vote)
                         modified = True
 
-        # accepted -> candidates
-        for a in list(self.accepted):
+        # accepted -> candidates (sorted: set iteration order must not
+        # leak into protocol behavior — detlint det-unsorted-iter)
+        for a in sorted(self.accepted):
             if a in self.candidates:
                 continue
             if self.slot.federated_ratify(
@@ -232,8 +233,12 @@ class NominationProtocol:
         self._update_round_leaders()
 
         updated = False
-        # add a few more values from the leaders' nominations
-        for leader in self.round_leaders:
+        # add a few more values from the leaders' nominations.  Sorted:
+        # _get_new_value_from_nomination skips values already in
+        # self.votes, so the pick is loop-carried — iterating the
+        # round_leaders SET in hash order made the voted values depend
+        # on PYTHONHASHSEED (the P0 detlint finding this PR fixes)
+        for leader in sorted(self.round_leaders):
             env = self.latest_nominations.get(leader)
             if env is not None:
                 v = self._get_new_value_from_nomination(

@@ -46,7 +46,10 @@ class TallyEngine:
 
         local = self.slot.local_node
         node_qsets: Dict[bytes, object] = {local.node_id: local.qset}
-        for n, env in envelopes.items():
+        # sorted iteration: the envelope map arrives keyed by node id
+        # (bytes) — tensor construction must not depend on arrival or
+        # hash order (detlint det-unsorted-iter)
+        for n, env in sorted(envelopes.items()):
             q = self.slot.qset_from_statement(env.statement)
             if q is None:
                 continue
@@ -55,7 +58,7 @@ class TallyEngine:
             (n, LN.qset_hash(q)) for n, q in node_qsets.items()))
         if key == self._cache_key:
             return self._tensors
-        for q in node_qsets.values():
+        for _, q in sorted(node_qsets.items()):
             if LN.qset_to_plain(q) is None:
                 self._cache_key = key
                 self._tensors = None  # >2-level qset: host only
@@ -63,7 +66,7 @@ class TallyEngine:
         # the universe covers every node any qset references (not just
         # envelope senders) — columns must exist for yet-silent validators
         universe = set(node_qsets)
-        for q in node_qsets.values():
+        for _, q in sorted(node_qsets.items()):
             universe |= LN.qset_nodes(q)
         node_order = sorted(universe)
         # unknown qset: threshold 1 with zero members is never satisfiable,

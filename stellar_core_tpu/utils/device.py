@@ -23,7 +23,7 @@ import time
 from typing import Optional
 
 _lock = threading.Lock()
-_result: Optional[bool] = None
+_result: Optional[bool] = None  # guarded-by: _lock
 
 
 class DeviceProbe:

@@ -45,11 +45,15 @@ _DEVICE_HEAVY = {
 
 
 def pytest_configure(config):
+    # single hook: a second pytest_configure def would silently shadow
+    # this one (that bug left sim/device unregistered until ISSUE 3)
     config.addinivalue_line(
         "markers", "sim: multi-node / subprocess simulation tests")
     config.addinivalue_line(
         "markers", "device: jit/pallas kernel tests dominated by XLA "
                    "compilation on host CPU")
+    config.addinivalue_line(
+        "markers", "slow: long-running (kernel interpret / multiprocess)")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -67,8 +71,3 @@ def _reseed_prngs():
     random.seed(12345)
     np.random.seed(12345)
     yield
-
-
-def pytest_configure(config):
-    config.addinivalue_line(
-        "markers", "slow: long-running (kernel interpret / multiprocess)")

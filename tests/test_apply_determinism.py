@@ -7,7 +7,16 @@ The bucket tier has carried a repeated-run guard since PR 1
 apply machinery — fee processing, hash-shuffled apply order, DEX
 crossing, meta emission — whose nondeterminism would fork a validator
 quorum even when each node's bucket merges are individually sound.
+
+ISSUE 3 extension: the same workload must also close bit-identically
+under DIFFERENT ``PYTHONHASHSEED`` values (two subprocesses), so
+hash-seed-dependent set/dict iteration feeding consensus data is caught
+at runtime as well as statically (detlint det-unsorted-iter).
 """
+import os
+import subprocess
+import sys
+
 from stellar_core_tpu.main import Application, test_config
 from stellar_core_tpu.main.http_server import CommandHandler
 from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
@@ -62,3 +71,43 @@ def test_same_tx_sets_close_bit_identical_twice():
         assert a[2] == b[2], f"tx meta diverged at close {i}"
     # the workload actually exercised the apply path (nonempty metas)
     assert any(len(m) > 200 for _, _, m in run1)
+
+
+_HASHSEED_WORKER = """
+import hashlib
+import sys
+
+sys.path.insert(0, {repo!r})
+from tests.test_apply_determinism import _run_mixed_workload
+
+for lh, bh, meta in _run_mixed_workload():
+    print(lh.hex(), bh.hex(), hashlib.sha256(meta).hexdigest())
+"""
+
+
+def test_close_bit_identical_under_hashseed_variation():
+    """Two subprocesses with different PYTHONHASHSEED values close the
+    same deterministic workload; every per-close fingerprint (ledger
+    hash, bucket hash, meta digest) must match.  PYTHONHASHSEED changes
+    bytes/str hashing, hence set iteration order — exactly the axis the
+    sorted-iteration fixes in scp/ and herder/ pin down."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    outputs = []
+    for seed in ("0", "4242"):
+        env = dict(os.environ)
+        env["PYTHONHASHSEED"] = seed
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "-c", _HASHSEED_WORKER.format(repo=repo)],
+            capture_output=True, text=True, cwd=repo, env=env,
+            timeout=600)
+        assert proc.returncode == 0, proc.stderr[-4000:]
+        lines = proc.stdout.strip().splitlines()
+        assert len(lines) >= 8, proc.stdout
+        outputs.append(lines)
+    a, b = outputs
+    assert len(a) == len(b)
+    for i, (la, lb) in enumerate(zip(a, b)):
+        assert la == lb, (
+            f"close {i} fingerprint diverged across PYTHONHASHSEED "
+            f"values:\n  seed 0   : {la}\n  seed 4242: {lb}")

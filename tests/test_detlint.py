@@ -1,0 +1,265 @@
+"""Tier-1 gate for the detlint static analyzer (ISSUE 3 tentpole).
+
+Two jobs: (1) the repo itself must be CLEAN — zero unbaselined
+findings, no stale baseline entries, no un-justified baseline entries —
+so the gate self-enforces on every future PR; (2) the analyzer must
+actually catch the bug classes it claims to (seeded injections into
+real module source must go red), or a green gate means nothing.
+"""
+import subprocess
+import sys
+
+from tools.lint import (
+    lint_repo, lint_sources, load_baseline, match_baseline,
+)
+from tools.lint.engine import REPO
+
+TALLY = "stellar_core_tpu/scp/tally.py"
+OPS = "stellar_core_tpu/ops/injected_kernel.py"
+BUCKET = "stellar_core_tpu/bucket/injected.py"
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# the gate itself
+# ---------------------------------------------------------------------------
+
+def test_repo_has_zero_unbaselined_findings():
+    findings = lint_repo()
+    baseline = load_baseline()
+    fresh, pinned, stale = match_baseline(findings, baseline)
+    assert not fresh, "unbaselined detlint findings:\n" + "\n".join(
+        f.render() for f in fresh)
+    assert not stale, (
+        "stale baseline entries (finding fixed? remove them):\n"
+        + "\n".join(str(e) for e in stale))
+
+
+def test_baseline_entries_are_justified():
+    for entry in load_baseline():
+        j = entry.get("justification", "")
+        assert j and not j.startswith("TODO"), (
+            f"baseline entry without a real justification: {entry}")
+
+
+def test_strict_cli_exits_zero_on_clean_repo():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--strict"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# acceptance: a seeded nondeterminism bug in scp/tally.py goes red
+# ---------------------------------------------------------------------------
+
+def _tally_source():
+    with open(f"{REPO}/{TALLY}", encoding="utf-8") as fh:
+        return fh.read()
+
+
+def test_injected_unsorted_items_feeding_hash_is_caught():
+    src = _tally_source() + '''
+
+def _fingerprint(envelopes):
+    import hashlib
+    h = hashlib.sha256()
+    for n, env in envelopes.items():
+        h.update(n)
+    return h.digest()
+'''
+    findings = lint_sources({TALLY: src})
+    hits = [f for f in findings if f.rule == "det-unsorted-iter"
+            and f.context == "_fingerprint"]
+    assert hits, [f.render() for f in findings]
+    # and it is UNBASELINED (strict would exit nonzero)
+    fresh, _, _ = match_baseline(findings, load_baseline())
+    assert any(f.context == "_fingerprint" for f in fresh)
+
+
+def test_injected_wallclock_read_is_caught():
+    src = _tally_source() + '''
+
+def _stamp(slot):
+    import time
+    return time.time()
+'''
+    findings = lint_sources({TALLY: src})
+    assert any(f.rule == "det-wallclock" and f.context == "_stamp"
+               for f in findings), [f.render() for f in findings]
+
+
+def test_current_tally_module_is_clean():
+    findings = lint_sources({TALLY: _tally_source()})
+    assert not findings, [f.render() for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# determinism rules, unit-level
+# ---------------------------------------------------------------------------
+
+def test_pragma_suppresses_finding():
+    src = '''
+import time
+
+
+def close_time():
+    # detlint: allow(det-wallclock)
+    return time.time()
+'''
+    assert not lint_sources({TALLY: src})
+
+
+def test_float_on_fee_is_caught_and_floordiv_is_not():
+    src = '''
+def rate(fee_bid, ops):
+    return fee_bid / ops
+'''
+    findings = lint_sources({TALLY: src})
+    assert _rules(findings) == {"det-float-consensus"}
+    src_ok = src.replace(" / ", " // ")
+    assert not lint_sources({TALLY: src_ok})
+
+
+def test_set_comprehension_and_sorted_consumer_are_exempt():
+    src = '''
+def tally(envelopes, pred):
+    voted = {n for n, env in envelopes.items() if pred(env)}
+    order = sorted(n for n in voted)
+    total = sum(len(n) for n in voted)
+    h = sha256(b"".join(order))
+    return h, total
+'''
+    assert not lint_sources({TALLY: src})
+
+
+def test_unsorted_iteration_without_sink_is_not_flagged():
+    src = '''
+def count(envelopes):
+    n = 0
+    for k, v in envelopes.items():
+        n += 1
+    return n
+'''
+    assert not lint_sources({TALLY: src})
+
+
+def test_jit_host_effect_is_caught():
+    src = '''
+import os
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=())
+def kernel(x):
+    if os.environ.get("DEBUG"):
+        print("tracing", x)
+    return x * 2
+
+
+def host_helper(x):
+    print(x)  # not jitted: fine
+    return x
+'''
+    findings = lint_sources({OPS: src})
+    assert all(f.rule == "det-jit-host-effect" for f in findings)
+    assert {f.context for f in findings} == {"kernel"}
+    assert len(findings) >= 2  # the environ read and the print
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline rules
+# ---------------------------------------------------------------------------
+
+_LOCKED_MODULE = '''
+import threading
+
+_lock = threading.Lock()
+_shared = set()  # guarded-by: _lock
+
+
+def good():
+    with _lock:
+        _shared.add(1)
+
+
+def bad():
+    _shared.add(2)
+
+
+class Pipeline:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._outputs = set()  # guarded-by: _mu
+        self._outputs.add(0)  # __init__ is construction: exempt
+
+    def good(self):
+        with self._mu:
+            self._outputs.discard(1)
+
+    def bad(self):
+        self._outputs |= {2}
+'''
+
+
+def test_lock_unguarded_write_is_caught():
+    findings = lint_sources({BUCKET: _LOCKED_MODULE})
+    assert all(f.rule == "lock-unguarded-write" for f in findings)
+    assert {(f.context, f.line_text) for f in findings} == {
+        ("bad", "_shared.add(2)"),
+        ("Pipeline.bad", "self._outputs |= {2}"),
+    }, [f.render() for f in findings]
+
+
+def test_lock_order_inversion_is_caught():
+    src = '''
+import threading
+
+_a_lock = threading.Lock()
+_b_lock = threading.Lock()
+
+
+def forward():
+    with _a_lock:
+        with _b_lock:
+            pass
+
+
+def backward():
+    with _b_lock:
+        with _a_lock:
+            pass
+'''
+    findings = lint_sources({BUCKET: src})
+    assert any(f.rule == "lock-order" for f in findings), \
+        [f.render() for f in findings]
+    src_consistent = src.replace(
+        "with _b_lock:\n        with _a_lock:",
+        "with _a_lock:\n        with _b_lock:")
+    assert not any(f.rule == "lock-order"
+                   for f in lint_sources({BUCKET: src_consistent}))
+
+
+def test_lock_unknown_guard_is_caught():
+    src = '''
+_shared = set()  # guarded-by: _phantom_lock
+
+
+def touch():
+    _shared.add(1)
+'''
+    findings = lint_sources({BUCKET: src})
+    assert "lock-unknown-guard" in _rules(findings)
+
+
+def test_repo_lock_annotations_are_honoured():
+    """The real bucket pipeline / native loader / device probe carry
+    guarded-by annotations and every mutation is inside its lock."""
+    findings = [f for f in lint_repo()
+                if f.rule.startswith("lock-")]
+    assert not findings, [f.render() for f in findings]

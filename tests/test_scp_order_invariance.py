@@ -1,0 +1,142 @@
+"""Regression for the P0 determinism findings (ISSUE 3): SCP tallies and
+nomination must be invariant under dict-insertion-order permutation of
+the envelope maps AND under PYTHONHASHSEED variation of set iteration
+order.
+
+Before this PR, ``NominationProtocol.nominate`` iterated the
+``round_leaders`` SET in hash order while ``_get_new_value_from_nomination``
+skipped values already voted — a loop-carried pick, so with several
+equal-priority leaders proposing OVERLAPPING values the voted set
+depended on PYTHONHASHSEED.  The subprocess test below reconstructs
+exactly that scenario and pins the emitted votes across seeds.
+"""
+import itertools
+import os
+import subprocess
+import sys
+
+from stellar_core_tpu.scp import SCP, make_qset, qset_hash
+
+from tests.test_scp import TestDriver, V, X, PREV, prepare_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def mk_scp():
+    qset = make_qset(4, V)
+    driver = TestDriver(qset)
+    scp = SCP(driver, V[0], True, qset)
+    return scp, driver, qset_hash(qset)
+
+
+def test_federated_tally_invariant_under_envelope_order():
+    """Same envelope set, every insertion order -> same accept/ratify
+    verdicts (host tally path)."""
+    verdicts = set()
+    for perm in itertools.permutations(range(1, 4)):
+        scp, driver, qh = mk_scp()
+        slot = scp.get_slot(1)
+        envelopes = {}
+        for i in perm:
+            envelopes[V[i]] = prepare_env(V[i], 1, qh, (1, X),
+                                          prepared=(1, X))
+        envelopes[V[0]] = prepare_env(V[0], 1, qh, (1, X))
+
+        def voted(st):
+            return True
+
+        def accepted(st):
+            return st.pledges.value.prepared is not None
+
+        verdicts.add((
+            slot.federated_accept(voted, accepted, envelopes),
+            slot.federated_ratify(voted, envelopes),
+        ))
+    assert verdicts == {(True, True)}
+
+
+def test_tensor_tally_build_invariant_under_envelope_order():
+    """TallyEngine._build's cache key + node order must not depend on
+    the envelope map's insertion order."""
+    from stellar_core_tpu.scp.tally import TallyEngine
+
+    keys = set()
+    orders = set()
+    for perm in itertools.permutations(range(4)):
+        scp, driver, qh = mk_scp()
+        slot = scp.get_slot(1)
+        slot.tally = TallyEngine(slot, "tensor")
+        envelopes = {}
+        for i in perm:
+            envelopes[V[i]] = prepare_env(V[i], 1, qh, (1, X))
+        t = slot.tally._build(envelopes)
+        assert t is not None
+        _, _, node_order = t
+        keys.add(slot.tally._cache_key)
+        orders.add(tuple(node_order))
+    assert len(keys) == 1
+    assert len(orders) == 1
+
+
+# ---------------------------------------------------------------------------
+# the multi-leader nomination P0, across hash seeds
+# ---------------------------------------------------------------------------
+
+# Three equal-top-priority leaders propose OVERLAPPING value pairs; the
+# leader-echo pick skips values already voted, so the voted set is a
+# function of leader iteration order.  Emits the final votes (the
+# nomination statement sorts them, but the SET content is what varied).
+_NOMINATION_WORKER = """
+import hashlib
+import sys
+
+sys.path.insert(0, {repo!r})
+
+from stellar_core_tpu.scp import SCP, make_qset, qset_hash
+from tests.test_scp import TestDriver, V, PREV, nominate_env
+
+LEADERS = set(V[1:4])
+A = hashlib.sha256(b"val-a").digest()
+B = hashlib.sha256(b"val-b").digest()
+C = hashlib.sha256(b"val-c").digest()
+
+qset = make_qset(4, V)
+driver = TestDriver(qset)
+driver.compute_hash_node = (
+    lambda slot_index, prev, is_priority, round_num, node_id:
+    (2**63 if node_id in LEADERS else 1) if is_priority else 0)
+scp = SCP(driver, V[0], True, qset)
+slot = scp.get_slot(1)
+qh = qset_hash(qset)
+nom = slot.nomination
+proposals = {{V[1]: [A, B], V[2]: [B, C], V[3]: [C, A]}}
+for node in V[1:4]:
+    nom.latest_nominations[node] = nominate_env(
+        node, 1, qh, proposals[node])
+slot.nominate(A, PREV, False)
+for v in sorted(nom.votes):
+    print(v.hex())
+"""
+
+
+def test_nomination_votes_invariant_under_hashseed():
+    """The emitted nomination vote set must be identical no matter how
+    PYTHONHASHSEED orders the round_leaders set."""
+    outputs = set()
+    runs = []
+    for seed in ("0", "1", "7", "4242"):
+        env = dict(os.environ)
+        env["PYTHONHASHSEED"] = seed
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "-c", _NOMINATION_WORKER.format(repo=REPO)],
+            capture_output=True, text=True, cwd=REPO, env=env,
+            timeout=300)
+        assert proc.returncode == 0, proc.stderr[-4000:]
+        out = proc.stdout.strip()
+        assert out, "worker emitted no votes"
+        outputs.add(out)
+        runs.append((seed, out))
+    assert len(outputs) == 1, (
+        "nomination votes depend on PYTHONHASHSEED:\n" + "\n".join(
+            f"  seed {s}: {o.replace(chr(10), ' ')}" for s, o in runs))

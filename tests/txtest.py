@@ -18,13 +18,13 @@ BASE_RESERVE = 5000000
 GENESIS_BALANCE = 10**17  # ~10B XLM in stroops
 
 
-def genesis_header(ledger_seq=1, close_time=1000):
+def genesis_header(ledger_seq=1, close_time=1000, protocol_version=19):
     sv = T.StellarValue.make(
         txSetHash=b"\x00" * 32, closeTime=close_time, upgrades=[],
         ext=T.StellarValue.fields[3][1].make(
             T.StellarValueType.STELLAR_VALUE_BASIC))
     return T.LedgerHeader.make(
-        ledgerVersion=19,
+        ledgerVersion=protocol_version,
         previousLedgerHash=b"\x00" * 32,
         scpValue=sv,
         txSetResultHash=b"\x00" * 32,
@@ -43,13 +43,17 @@ def genesis_header(ledger_seq=1, close_time=1000):
 
 
 class TestLedger:
-    """In-memory root + genesis account."""
+    """In-memory root + genesis account.  ``protocol_version`` pins the
+    genesis header's ledgerVersion so the hard-coded v19 version gates
+    can be exercised at every gated protocol (ROADMAP item 3 /
+    tests/test_protocol_versions.py)."""
 
-    def __init__(self):
+    def __init__(self, protocol_version: int = 19):
+        self.protocol_version = protocol_version
         self.db = open_database(":memory:")
         self.root_txn = LedgerTxnRoot(self.db)
         self.root_key = SecretKey(sha256(b"genesis-root"))
-        hdr = genesis_header()
+        hdr = genesis_header(protocol_version=protocol_version)
         with LedgerTxn(self.root_txn) as ltx:
             ltx.set_header(hdr)
             # bootstrap: write header first so put() can stamp seq

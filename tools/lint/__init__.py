@@ -1,0 +1,25 @@
+"""detlint: consensus-determinism & lock-discipline static analyzer.
+
+The reproduction's value proposition is that the TPU hot path stays
+bit-identical to the CPU reference — detlint is the mechanical guard
+that keeps PRs from quietly breaking that.  Two rule families:
+
+* determinism rules (tools/lint/determinism.py) over the
+  consensus-critical modules: wall-clock/random/env reads, unsorted
+  dict-view/set iteration feeding hashes/serialization/tallies, float
+  arithmetic on ledger values, host-side effects inside jax.jit kernels;
+* lock-discipline rules (tools/lint/locks.py) for the threaded
+  subsystems: ``# guarded-by: <lock>`` annotated fields mutated outside
+  a ``with <lock>:`` scope, and inconsistent lock-acquisition order.
+
+Pre-existing intentional findings live in tools/lint/baseline.json
+(one-line justification each); point cases carry an inline
+``# detlint: allow(<rule>)`` pragma.  ``python -m tools.lint --strict``
+exits nonzero on any unbaselined finding and is wired into
+tools/verify_green.py ahead of pytest, plus tests/test_detlint.py as a
+tier-1 test — the gate self-enforces on every PR.
+"""
+from .engine import (  # noqa: F401
+    Finding, lint_paths, lint_repo, lint_sources, load_baseline,
+    match_baseline,
+)

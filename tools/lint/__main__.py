@@ -1,0 +1,105 @@
+"""CLI: ``python -m tools.lint [--strict] [--json] [paths...]``.
+
+Exit codes: 0 clean (or non-strict), 1 unbaselined findings in
+--strict, 2 usage/internal error.  ``--write-baseline`` regenerates
+baseline.json from the current findings, preserving justifications of
+entries that still match and stamping new ones ``TODO: justify`` —
+the PR author must replace every TODO before the gate goes green
+(tests/test_detlint.py enforces this).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .engine import (
+    BASELINE_PATH, REPO, baseline_entry, lint_paths, lint_repo,
+    load_baseline, match_baseline,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="detlint: consensus-determinism & lock-discipline "
+                    "static analyzer")
+    ap.add_argument("paths", nargs="*",
+                    help="repo-relative files to lint (default: the "
+                         "whole stellar_core_tpu package)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on any unbaselined finding")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--baseline", default=BASELINE_PATH,
+                    help="baseline file (default: tools/lint/baseline.json)")
+    ap.add_argument("--root", default=REPO, help=argparse.SUPPRESS)
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from current findings")
+    args = ap.parse_args(argv)
+
+    if args.write_baseline and args.paths:
+        print("detlint: --write-baseline requires a full-repo run — a "
+              "scoped run would truncate the baseline to the given "
+              "paths' findings", file=sys.stderr)
+        return 2
+    if args.paths:
+        try:
+            findings = lint_paths(args.paths, args.root)
+        except FileNotFoundError as e:
+            print(f"detlint: {e}", file=sys.stderr)
+            return 2
+    else:
+        findings = lint_repo(args.root)
+    baseline = load_baseline(args.baseline)
+    fresh, pinned, stale = match_baseline(findings, baseline)
+    if args.paths:
+        # a scoped run cannot see findings outside its paths — staleness
+        # is only meaningful against the full repo
+        stale = []
+
+    if args.write_baseline:
+        old = {(e["rule"], e["file"], e["context"], e["line_text"]):
+               e.get("justification", "") for e in baseline}
+        entries, seen = [], set()
+        for f in findings:
+            if f.identity() in seen:
+                continue
+            seen.add(f.identity())
+            entries.append(baseline_entry(
+                f, old.get(f.identity()) or "TODO: justify"))
+        with open(args.baseline, "w", encoding="utf-8") as fh:
+            json.dump({"findings": entries}, fh, indent=1, sort_keys=False)
+            fh.write("\n")
+        print(f"detlint: wrote {len(entries)} baseline entries to "
+              f"{args.baseline}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "unbaselined": [f.__dict__ for f in fresh],
+            "baselined": [f.__dict__ for f in pinned],
+            "stale_baseline_entries": stale,
+        }, indent=1))
+    else:
+        for f in fresh:
+            print(f.render())
+        for e in stale:
+            print(f"detlint: stale baseline entry (finding fixed? remove "
+                  f"it): {e['file']} [{e['context']}] {e['rule']}: "
+                  f"{e['line_text']!r}")
+        print(f"detlint: {len(findings)} findings "
+              f"({len(fresh)} unbaselined, {len(pinned)} baselined, "
+              f"{len(stale)} stale baseline entries)")
+
+    if args.strict and fresh:
+        if not args.as_json:
+            print("detlint: STRICT FAILURE — fix the findings above, add "
+                  "a '# detlint: allow(<rule>)' pragma with a reason, or "
+                  "baseline them with a justification", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,263 @@
+"""detlint engine: file discovery, AST parse, rule dispatch, pragma
+suppression, and baseline matching.
+
+Finding identity is (rule, file, context, line_text) — deliberately NOT
+the line number, so a baseline entry survives unrelated edits shifting
+code up or down.  ``context`` is the dotted class/function path
+(``TallyEngine._build``) or ``<module>`` for top-level code.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+PACKAGE = "stellar_core_tpu"
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.json")
+
+# consensus-critical module prefixes (relative to the package root):
+# nondeterminism here forks validators (ISSUE 3)
+CONSENSUS_DIRS = ("scp", "herder", "ledger", "bucket", "transactions",
+                  "xdr", "crypto")
+# device-kernel modules: host-side effects inside jax.jit break
+# trace/replay determinism
+KERNEL_DIRS = ("ops",)
+
+_PRAGMA_RE = re.compile(r"#\s*detlint:\s*allow\(([^)]*)\)")
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None (shared by the
+    determinism and lock rule modules)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    file: str          # repo-relative path
+    line: int
+    col: int
+    context: str       # dotted class/function path
+    message: str
+    line_text: str     # stripped source of the flagged line
+
+    def identity(self) -> Tuple[str, str, str, str]:
+        return (self.rule, self.file, self.context, self.line_text)
+
+    def render(self) -> str:
+        return (f"{self.file}:{self.line}:{self.col}: {self.rule}: "
+                f"{self.message} [{self.context}]")
+
+
+@dataclass
+class FileInfo:
+    """Parsed per-file input handed to every rule module."""
+    path: str                      # repo-relative, '/'-separated
+    source: str
+    tree: ast.AST
+    lines: List[str]
+    pragmas: Dict[int, Set[str]] = field(default_factory=dict)
+    # line -> lock name for "# guarded-by: <lock>" annotations
+    guards: Dict[int, str] = field(default_factory=dict)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def in_consensus(self) -> bool:
+        return self._under(CONSENSUS_DIRS)
+
+    def in_kernels(self) -> bool:
+        return self._under(KERNEL_DIRS)
+
+    def _under(self, dirs: Sequence[str]) -> bool:
+        parts = self.path.split("/")
+        if PACKAGE not in parts:
+            return False
+        rest = parts[parts.index(PACKAGE) + 1:]
+        return bool(rest) and rest[0] in dirs
+
+
+class ContextVisitor(ast.NodeVisitor):
+    """Base visitor tracking the dotted class/function context."""
+
+    def __init__(self, info: FileInfo):
+        self.info = info
+        self.stack: List[str] = []
+        self.findings: List[Finding] = []
+
+    @property
+    def context(self) -> str:
+        return ".".join(self.stack) if self.stack else "<module>"
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def _visit_func(self, node) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def add(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        self.findings.append(Finding(
+            rule=rule, file=self.info.path, line=line, col=col,
+            context=self.context, message=message,
+            line_text=self.info.line_text(line)))
+
+
+def _scan_comments(info: FileInfo) -> None:
+    for i, raw in enumerate(info.lines, start=1):
+        m = _PRAGMA_RE.search(raw)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            info.pragmas[i] = rules
+        g = _GUARDED_BY_RE.search(raw)
+        if g:
+            info.guards[i] = g.group(1)
+
+
+def _suppressed(info: FileInfo, f: Finding) -> bool:
+    """A pragma suppresses a finding on its own line or the line above
+    (for statements whose flagged line has no room for a comment)."""
+    for line in (f.line, f.line - 1):
+        rules = info.pragmas.get(line)
+        if rules and (f.rule in rules or "*" in rules):
+            return True
+    return False
+
+
+def _parse_file(relpath: str, source: str) -> Optional[FileInfo]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return None
+    info = FileInfo(path=relpath.replace(os.sep, "/"), source=source,
+                    tree=tree, lines=source.splitlines())
+    _scan_comments(info)
+    return info
+
+
+def discover_files(root: str = REPO) -> List[str]:
+    """Repo-relative paths of every package .py file under analysis."""
+    out: List[str] = []
+    pkg_root = os.path.join(root, PACKAGE)
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                full = os.path.join(dirpath, name)
+                out.append(os.path.relpath(full, root))
+    return sorted(out)
+
+
+def lint_sources(sources: Dict[str, str]) -> List[Finding]:
+    """Analyze {repo-relative-path: source}; the seam tests use to lint
+    injected/mutated source without touching the working tree."""
+    from . import determinism, locks
+
+    infos: List[FileInfo] = []
+    for relpath, source in sorted(sources.items()):
+        info = _parse_file(relpath, source)
+        if info is not None:
+            infos.append(info)
+    findings: List[Finding] = []
+    for info in infos:
+        findings.extend(determinism.check(info))
+    findings.extend(locks.check(infos))
+    out = []
+    by_path = {i.path: i for i in infos}
+    for f in findings:
+        info = by_path.get(f.file)
+        if info is not None and _suppressed(info, f):
+            continue
+        out.append(f)
+    return sorted(out, key=lambda f: (f.file, f.line, f.col, f.rule))
+
+
+def lint_paths(relpaths: Iterable[str], root: str = REPO) -> List[Finding]:
+    """Lint specific repo-relative files; raises FileNotFoundError on an
+    unreadable path — a scoped run must never silently report a file it
+    never analyzed as clean."""
+    sources: Dict[str, str] = {}
+    missing: List[str] = []
+    for rel in relpaths:
+        full = os.path.join(root, rel)
+        try:
+            with open(full, encoding="utf-8") as fh:
+                sources[rel] = fh.read()
+        except OSError:
+            missing.append(rel)
+    if missing:
+        raise FileNotFoundError(
+            f"cannot read: {', '.join(missing)}")
+    return lint_sources(sources)
+
+
+def lint_repo(root: str = REPO) -> List[Finding]:
+    return lint_paths(discover_files(root), root)
+
+
+# -- baseline ---------------------------------------------------------------
+
+def load_baseline(path: str = BASELINE_PATH) -> List[dict]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+    except OSError:
+        return []
+    return data.get("findings", [])
+
+
+def match_baseline(findings: Sequence[Finding],
+                   baseline: Sequence[dict]
+                   ) -> Tuple[List[Finding], List[Finding], List[dict]]:
+    """Split into (unbaselined, baselined, stale_entries).
+
+    An entry matches any number of findings with the same
+    (rule, file, context, line_text) — several identical metric-timer
+    lines in one function are one entry.  Entries matching nothing are
+    stale (reported, not fatal: the finding was fixed)."""
+    table: Dict[Tuple[str, str, str, str], dict] = {}
+    for entry in baseline:
+        key = (entry.get("rule", ""), entry.get("file", ""),
+               entry.get("context", ""), entry.get("line_text", ""))
+        table[key] = entry
+    used: Set[Tuple[str, str, str, str]] = set()
+    fresh: List[Finding] = []
+    pinned: List[Finding] = []
+    for f in findings:
+        if f.identity() in table:
+            pinned.append(f)
+            used.add(f.identity())
+        else:
+            fresh.append(f)
+    stale = [e for k, e in table.items() if k not in used]
+    return fresh, pinned, stale
+
+
+def baseline_entry(f: Finding, justification: str) -> dict:
+    return {"rule": f.rule, "file": f.file, "context": f.context,
+            "line_text": f.line_text, "justification": justification}

@@ -1,13 +1,17 @@
 #!/usr/bin/env python
 """The snapshot-must-be-green gate (VERDICT r5; ISSUE r7 satellite): run
-the tier-1 command EXACTLY as ROADMAP.md states it and exit nonzero on
-any test failure OR collection error.
+the detlint static analyzer in --strict mode, then the tier-1 command
+EXACTLY as ROADMAP.md states it, and exit nonzero on any unbaselined
+lint finding, test failure OR collection error.
 
-The tier-1 command is parsed out of ROADMAP.md (single source of truth:
-the driver, the builder and this gate all run the same line).  pytest's
-exit code already covers failures; collection errors are additionally
-grepped out of the log because `--continue-on-collection-errors` can
-leave a "green-looking" run that silently skipped whole files.
+Lint findings are reported DISTINCTLY from test failures (separate
+"verify_green: LINT RED" line) so a red gate immediately says which
+discipline broke.  The tier-1 command is parsed out of ROADMAP.md
+(single source of truth: the driver, the builder and this gate all run
+the same line).  pytest's exit code already covers failures; collection
+errors are additionally grepped out of the log because
+`--continue-on-collection-errors` can leave a "green-looking" run that
+silently skipped whole files.
 
 Usage: python tools/verify_green.py        -> exit 0 iff green
 """
@@ -29,7 +33,21 @@ def tier1_command() -> str:
     return m.group(1)
 
 
+def run_detlint() -> int:
+    """python -m tools.lint --strict; nonzero = unbaselined findings."""
+    print("verify_green: python -m tools.lint --strict", flush=True)
+    proc = subprocess.run([sys.executable, "-m", "tools.lint", "--strict"],
+                          cwd=REPO)
+    return proc.returncode
+
+
 def main() -> int:
+    lint_rc = run_detlint()
+    if lint_rc != 0:
+        # distinct from test failures: the analyzer itself printed the
+        # findings; still run the tests so one gate run reports both
+        print(f"verify_green: LINT RED (detlint --strict exited "
+              f"{lint_rc})", flush=True)
     cmd = tier1_command()
     print(f"verify_green: {cmd}", flush=True)
     proc = subprocess.run(["bash", "-c", cmd], cwd=REPO)
@@ -55,11 +73,15 @@ def main() -> int:
         problems.append("ERRORS section in pytest output")
     m = re.search(r"\b(\d+) passed\b", tail)
     passed = m.group(1) if m else "?"
+    if lint_rc != 0:
+        problems.append("unbaselined detlint findings (see LINT RED "
+                        "above)")
     if problems:
         print(f"verify_green: RED ({'; '.join(problems)}); "
               f"passed={passed}", flush=True)
         return 1
-    print(f"verify_green: GREEN (passed={passed})", flush=True)
+    print(f"verify_green: GREEN (passed={passed}, detlint clean)",
+          flush=True)
     return 0
 
 
