@@ -159,8 +159,16 @@ def main() -> None:
 
     pay_times, _pay_phases = run_closes("pay")
     close_times, close_phases = run_closes("mixed")
+    # tracing-disabled A/B in the same session: the flight recorder's
+    # span instrumentation must cost <1% of close p50 when recording is
+    # off (the always-on cost is two perf_counter reads per span)
+    app.tracer.enabled = False
+    disabled_times, _ = run_closes("mixed")
+    app.tracer.enabled = True
     pay_p50 = statistics.median(pay_times) if pay_times else None
     close_p50 = statistics.median(close_times) if close_times else None
+    disabled_p50 = (statistics.median(disabled_times)
+                    if disabled_times else None)
     import math
 
     close_p99 = (sorted(close_times)[
@@ -173,6 +181,62 @@ def main() -> None:
               f"{len(close_times)} closes (crossing level-0/1 spill "
               "boundaries; FutureBucket staging + deferred GC keep "
               "p99 near p50)")
+
+    # --- flight-recorder evidence: per-op-type apply attribution + the
+    # tracing-overhead measurement, persisted to BENCH_TRACE_r08.json ---
+    op_keys = sorted({k for row in close_phases
+                      for k in (row.get("apply_ops") or {})})
+    apply_op_type_ms = {
+        k: round(statistics.median(
+            (row.get("apply_ops") or {}).get(k, 0.0)
+            for row in close_phases), 3)
+        for k in op_keys}
+    _note(f"apply_op_type_ms (median/close): {apply_op_type_ms}")
+    # disabled-span microcost: a Span always takes two perf_counter
+    # reads; recording is skipped when disabled
+    from stellar_core_tpu.utils.tracing import Tracer
+
+    _dis = Tracer(enabled=False)
+    n_probe = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n_probe):
+        with _dis.span("bench.overhead.probe"):
+            pass
+    disabled_span_ns = (time.perf_counter() - t0) / n_probe * 1e9
+    last_rec = app.tracer.get_close()
+    spans_per_close = len(last_rec.spans) if last_rec is not None else 0
+    disabled_overhead_pct = (
+        round(disabled_span_ns * 1e-6 * spans_per_close
+              / close_p50 * 100.0, 4)
+        if close_p50 else None)
+    trace_line = {
+        "metric": "ledger_close_flight_recorder",
+        "close_txs": close_txs,
+        "close_shape": f"mixed({dex_pct}% dex)",
+        "close_samples": len(close_times),
+        "apply_op_type_ms": apply_op_type_ms,
+        "close_p50_ms_tracing_enabled": (round(close_p50, 2)
+                                         if close_p50 else None),
+        "close_p50_ms_tracing_disabled": (round(disabled_p50, 2)
+                                          if disabled_p50 else None),
+        "spans_per_close": spans_per_close,
+        "disabled_span_cost_ns": round(disabled_span_ns, 1),
+        "tracing_disabled_overhead_pct_of_close_p50":
+            disabled_overhead_pct,
+        "close_phase_ms_median": {
+            ph: round(statistics.median(
+                row.get(ph, 0.0) for row in close_phases), 3)
+            for ph in ("prefetch", "verify", "fee", "apply", "upgrades",
+                       "hash", "bucket", "spill_wait", "bucket_hash",
+                       "commit", "meta", "gc", "total")
+        } if close_phases else None,
+    }
+    with open(os.path.join(REPO, "BENCH_TRACE_r08.json"), "w") as f:
+        json.dump(trace_line, f, indent=1)
+    _note(f"tracing overhead: {disabled_span_ns:.0f}ns/span disabled x "
+          f"{spans_per_close} spans/close = "
+          f"{disabled_overhead_pct}% of close p50 "
+          f"(persisted to BENCH_TRACE_r08.json)")
 
     # --- device stage (subprocess owns the TPU) ---
     device_result = None
@@ -256,6 +320,9 @@ def main() -> None:
         "close_shape": f"mixed({dex_pct}% dex)",
         "ledger_close_p50_ms_payments": (round(pay_p50, 1)
                                          if pay_p50 is not None else None),
+        # flight recorder: per-op-type apply attribution (median ms per
+        # mixed close) — full detail in BENCH_TRACE_r08.json
+        "apply_op_type_ms": apply_op_type_ms,
         # per-phase close breakdown (median ms across the mixed closes):
         # verify/fee/apply/bucket(spill_wait,bucket_hash)/hash/commit/gc —
         # the async-merge-pipeline evidence future BENCH_r*.json track

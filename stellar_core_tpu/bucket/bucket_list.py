@@ -418,6 +418,12 @@ class BucketList:
         # bloom-first point reads (default on); read_bench flips this
         # off for the linear-scan baseline and the hash-parity check
         self.index_enabled = True
+        # flight recorder (utils/tracing): BucketManager re-points this
+        # at the owning app's tracer; spans staged here cross the merge
+        # worker threads with explicit parent tokens
+        from ..utils.tracing import NULL_TRACER
+
+        self.tracer = NULL_TRACER
 
     def hash(self) -> bytes:
         """Cumulative commitment: sha256 over all level hashes
@@ -445,19 +451,19 @@ class BucketList:
         if self.executor is not None:
             for level in spilled:
                 self._stage_next_merge(level, ledger_seq)
-        import time as _time
+        from ..utils.tracing import stopwatch
 
         # index the close's new level-0 bucket at creation time (spill
         # outputs are indexed by the merge that built them); the cost is
         # tracked so READ_BENCH can prove it stays <10% of close p50
         if self.index_enabled:
-            t0 = _time.perf_counter()
-            self.levels[0].curr.ensure_index()
-            self.stats["index_build_s"] += _time.perf_counter() - t0
+            with stopwatch() as sw:
+                self.levels[0].curr.ensure_index()
+            self.stats["index_build_s"] += sw.seconds
 
-        t0 = _time.perf_counter()
-        out = self.hash()
-        self.stats["hash_s"] += _time.perf_counter() - t0
+        with self.tracer.span("bucket.hash"), stopwatch() as sw:
+            out = self.hash()
+        self.stats["hash_s"] += sw.seconds
         return out
 
     def _stage_next_merge(self, level: int, ledger_seq: int) -> None:
@@ -470,13 +476,17 @@ class BucketList:
         re-tiering snap against an EMPTY partner (curr_ref None)."""
         snap = self.levels[level].snap
         nxt_spill = ledger_seq + level_half(level)
+        # cross-thread span parenting: the worker's merge span hangs off
+        # whatever span is open on the staging (close) thread right now
+        parent = self.tracer.current_id()
         if level_should_spill(nxt_spill, level + 1):
             curr: Optional[Bucket] = None
             fut = self.executor.submit(self._bg_merge, level, snap,
-                                       Bucket())
+                                       Bucket(), parent)
         else:
             curr = self.levels[level + 1].curr
-            fut = self.executor.submit(self._bg_merge, level, snap, curr)
+            fut = self.executor.submit(self._bg_merge, level, snap, curr,
+                                       parent)
         self._futures[level] = (snap, curr, fut)
         self.stats["staged_merges"] += 1
 
@@ -489,6 +499,8 @@ class BucketList:
         included) — only a first-spill-after-restore or executor-less
         list merges inline, and only non-trivial inline merges count as
         sync fallbacks."""
+        from ..utils.tracing import stopwatch
+
         staged = self._futures.pop(level, None)
         if staged is not None:
             snap_ref, curr_ref, fut = staged
@@ -496,11 +508,10 @@ class BucketList:
                 curr_ref is curr if curr_ref is not None
                 else curr.is_empty())
             if ok:
-                import time as _time
-
-                t0 = _time.perf_counter()
-                out = fut.result()
-                self.stats["spill_wait_s"] += _time.perf_counter() - t0
+                with self.tracer.span("bucket.spill.wait", level=level), \
+                        stopwatch() as sw:
+                    out = fut.result()
+                self.stats["spill_wait_s"] += sw.seconds
                 self.stats["resolved_merges"] += 1
                 self._unprotect(out)
                 return out
@@ -510,13 +521,18 @@ class BucketList:
         if self.executor is not None and \
                 not (snap.is_empty() and curr.is_empty()):
             self.stats["sync_fallback_merges"] += 1
-        out = merge_buckets(snap, curr, self._merge_dir(level + 1))
-        if self.index_enabled and not out.is_empty():
-            import time as _time
+            from ..utils.logging import get_logger
 
-            t0 = _time.perf_counter()
-            out.ensure_index()
-            self.stats["index_build_s"] += _time.perf_counter() - t0
+            get_logger("Bucket").warning(
+                "sync-fallback merge at level %d (%d+%d entries) — "
+                "staged future missed its inputs", level, len(snap),
+                len(curr))
+        with self.tracer.span("bucket.merge.sync", level=level):
+            out = merge_buckets(snap, curr, self._merge_dir(level + 1))
+        if self.index_enabled and not out.is_empty():
+            with stopwatch() as sw:
+                out.ensure_index()
+            self.stats["index_build_s"] += sw.seconds
         return out
 
     def _protect_bg_output(self, hash_hex: str) -> None:
@@ -543,12 +559,18 @@ class BucketList:
             return self.disk_dir
         return None
 
-    def _bg_merge(self, level: int, newer, older):
-        out = merge_buckets(newer, older, self._merge_dir(level + 1),
-                            protect=self._protect_bg_output)
-        out.hash()  # pre-hash too: off the close critical path
-        if self.index_enabled and not out.is_empty():
-            out.ensure_index()  # index handed off with the output
+    def _bg_merge(self, level: int, newer, older, parent_span=None):
+        # the worker-pool span: explicitly parented to the close-thread
+        # span that staged this merge (the flight recorder's
+        # cross-thread linkage)
+        with self.tracer.span("bucket.merge.background",
+                              parent=parent_span, level=level,
+                              n_newer=len(newer), n_older=len(older)):
+            out = merge_buckets(newer, older, self._merge_dir(level + 1),
+                                protect=self._protect_bg_output)
+            out.hash()  # pre-hash too: off the close critical path
+            if self.index_enabled and not out.is_empty():
+                out.ensure_index()  # index handed off with the output
         return out
 
     def pending_merge_hashes(self) -> set:
@@ -651,12 +673,12 @@ class BucketList:
     def ensure_indexes(self) -> None:
         """Build any missing bucket indexes now (restore/adoption path);
         build time lands in stats["index_build_s"]."""
-        import time as _time
+        from ..utils.tracing import stopwatch
 
-        t0 = _time.perf_counter()
-        for bucket in self._buckets_shallow_first():
-            bucket.ensure_index()
-        self.stats["index_build_s"] += _time.perf_counter() - t0
+        with stopwatch() as sw:
+            for bucket in self._buckets_shallow_first():
+                bucket.ensure_index()
+        self.stats["index_build_s"] += sw.seconds
 
     def index_memory_bytes(self) -> int:
         """Resident bytes of all built indexes (bloom words + dict
@@ -785,6 +807,7 @@ class BucketManager:
                              "DISK_BUCKET_LEVEL", None)
         self.bucket_list = BucketList(self.executor, disk_dir=bucket_dir,
                                       disk_level=disk_level)
+        self._attach_tracer()
         if bucket_dir:
             import os
 
@@ -795,6 +818,13 @@ class BucketManager:
         # renaming its output between the dir scan and the futures check
         # can never lose the file it just wrote
         self._gc_candidates: set = set()
+
+    def _attach_tracer(self) -> None:
+        """Point the (possibly just-swapped) bucket list at the owning
+        app's flight recorder."""
+        from ..utils.tracing import tracer_of
+
+        self.bucket_list.tracer = tracer_of(self)
 
     def add_batch(self, ledger_seq: int, changes) -> bytes:
         h = self.bucket_list.add_batch(ledger_seq, changes)
@@ -936,6 +966,7 @@ class BucketManager:
             disk_level=getattr(getattr(self.app, "config", None),
                                "DISK_BUCKET_LEVEL", None))
         self.bucket_list.executor = self.executor
+        self._attach_tracer()
         self._saved = {hh for pair in level_hashes for hh in pair
                        if hh != "00" * 32}
 
@@ -945,6 +976,7 @@ class BucketManager:
         going to disk."""
         self.bucket_list = bucket_list
         self.bucket_list.executor = self.executor
+        self._attach_tracer()
         self.bucket_list.disk_dir = self.bucket_dir
         disk_level = getattr(getattr(self.app, "config", None),
                              "DISK_BUCKET_LEVEL", None)

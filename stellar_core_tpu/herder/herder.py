@@ -317,6 +317,11 @@ class Herder:
         self.state = HerderState.NOT_TRACKING
         self.lost_sync_count += 1
         self.app.metrics.counter("herder.lost-sync").inc()
+        from ..utils.logging import get_logger
+
+        get_logger("Herder").warning(
+            "lost consensus sync (no externalize within %.1fs); "
+            "starting out-of-sync recovery", self._stuck_timeout())
         self._out_of_sync_recovery()
 
     def _out_of_sync_recovery(self) -> None:
@@ -346,21 +351,27 @@ class Herder:
     def recv_transaction(self, env) -> int:
         """HTTP 'tx' or peer TRANSACTION message -> queue
         (ref recvTransaction :458)."""
-        res = self.tx_queue.try_add(env)
-        if res == TransactionQueue.ADD_STATUS_PENDING:
-            self.app.broadcast_transaction(env)
+        with self.app.tracer.span("herder.tx.admit") as sp:
+            res = self.tx_queue.try_add(env)
+            if res == TransactionQueue.ADD_STATUS_PENDING:
+                self.app.broadcast_transaction(env)
+            if sp.args is None:
+                sp.args = {}
+            sp.args["status"] = res
         return res
 
     # -- SCP plumbing -------------------------------------------------------
 
     def recv_scp_envelope(self, env) -> EnvelopeState:
         """ref recvSCPEnvelope :624 + PendingEnvelopes fetch logic."""
-        missing = self.pending_envelopes.missing_for(env)
-        if missing:
-            self.pending_envelopes.record_pending(env, missing)
-            self.app.request_scp_items(missing)
-            return EnvelopeState.VALID
-        return self.deliver_ready_envelope(env)
+        with self.app.tracer.span("herder.scp.recv",
+                                  slot=env.statement.slotIndex):
+            missing = self.pending_envelopes.missing_for(env)
+            if missing:
+                self.pending_envelopes.record_pending(env, missing)
+                self.app.request_scp_items(missing)
+                return EnvelopeState.VALID
+            return self.deliver_ready_envelope(env)
 
     def deliver_ready_envelope(self, env) -> EnvelopeState:
         """The single seam every ready envelope passes through: SCP
@@ -408,12 +419,13 @@ class Herder:
         lcl_hash = lm.last_closed_hash()
         slot = lm.last_closed_seq() + 1
 
-        frames = self.tx_queue.get_transactions()
-        tx_set = TxSetFrame.make_from_transactions(
-            self.app.config.network_id(), lcl_hash, frames, lm.root,
-            max_tx_set_size or lcl_header.maxTxSetSize,
-            lcl_header.baseFee)
-        self.pending_envelopes.add_tx_set(tx_set)
+        with self.app.tracer.span("herder.trigger.txset", slot=slot):
+            frames = self.tx_queue.get_transactions()
+            tx_set = TxSetFrame.make_from_transactions(
+                self.app.config.network_id(), lcl_hash, frames, lm.root,
+                max_tx_set_size or lcl_header.maxTxSetSize,
+                lcl_header.baseFee)
+            self.pending_envelopes.add_tx_set(tx_set)
 
         close_time = max(
             int(self.app.clock.system_now()),
@@ -448,6 +460,11 @@ class Herder:
         tx_set = self.pending_envelopes.get_tx_set(sv.txSetHash)
         if tx_set is None:
             raise RuntimeError("externalized value with unknown tx set")
+        from ..utils.logging import get_logger
+
+        get_logger("SCP").debug(
+            "externalized slot %d (%d txs, closeTime %d)",
+            slot_index, tx_set.size(), sv.closeTime)
         back_in_sync = self.state != HerderState.TRACKING
         self.state = HerderState.TRACKING
         self._tracking_slot = slot_index

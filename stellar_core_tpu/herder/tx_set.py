@@ -215,10 +215,17 @@ class TxSetFrame:
                             index.append((fi, i, pub))
         return triples, index
 
-    def prevalidate_signatures(self, use_device: bool = True
+    def prevalidate_signatures(self, use_device: bool = True, tracer=None
                                ) -> Dict[Tuple[bytes, bytes, bytes], bool]:
         """Verify the whole set's signatures as one batch; returns a verdict
-        cache keyed by (pubkey, signature, msg) for SignatureChecker."""
+        cache keyed by (pubkey, signature, msg) for SignatureChecker.
+
+        ``tracer`` (utils/tracing) splits the device leg into a dispatch
+        span (batch assembly + async JAX dispatch) and a host-wait span
+        (blocking on the device result) — the host-Python-vs-kernel-time
+        attribution ROADMAP item 7 asks about."""
+        if tracer is None:
+            from ..utils.tracing import NULL_TRACER as tracer
         triples, _ = self.collect_signature_batch()
         if not triples:
             return {}
@@ -241,30 +248,43 @@ class TxSetFrame:
 
             from ..utils.device import pad_signature_batch
 
-            n = len(triples)
-            pk = np.frombuffer(
-                b"".join(t[0] for t in triples), np.uint8).reshape(n, 32)
-            sg = np.frombuffer(
-                b"".join(t[1].ljust(64, b"\x00") for t in triples),
-                np.uint8).reshape(n, 64)
-            mg = np.frombuffer(
-                b"".join(t[2] for t in triples), np.uint8).reshape(n, 32)
-            # pad to a fixed batch bucket (repeating real rows) so the
-            # device sees a small closed set of shapes — per-close batch
-            # sizes vary freely and would otherwise force a recompile
-            # every time a new size shows up
-            padded = pad_signature_batch(n)
-            if padded != n:
-                idx = np.arange(padded) % n
-                pk, sg, mg = pk[idx], sg[idx], mg[idx]
-            ok = np.asarray(verify_batch(pk, sg, mg))[:n]
+            with tracer.span("crypto.sigbatch.dispatch",
+                             n_sigs=len(triples)):
+                n = len(triples)
+                pk = np.frombuffer(
+                    b"".join(t[0] for t in triples),
+                    np.uint8).reshape(n, 32)
+                sg = np.frombuffer(
+                    b"".join(t[1].ljust(64, b"\x00") for t in triples),
+                    np.uint8).reshape(n, 64)
+                mg = np.frombuffer(
+                    b"".join(t[2] for t in triples),
+                    np.uint8).reshape(n, 32)
+                # pad to a fixed batch bucket (repeating real rows) so the
+                # device sees a small closed set of shapes — per-close
+                # batch sizes vary freely and would otherwise force a
+                # recompile every time a new size shows up
+                padded = pad_signature_batch(n)
+                if padded != n:
+                    idx = np.arange(padded) % n
+                    pk, sg, mg = pk[idx], sg[idx], mg[idx]
+                # JAX dispatch is async: this returns as soon as the
+                # computation is enqueued on the device
+                pending = verify_batch(pk, sg, mg)
+            with tracer.span("crypto.sigbatch.host_wait"):
+                # materializing blocks until the device result lands —
+                # the dispatch/host-wait split is the JAX-overhead vs.
+                # kernel-time attribution
+                ok = np.asarray(pending)[:n]
             for t, v in zip(triples, ok):
                 verdicts[(t[0], t[1], t[2])] = bool(v)
         else:
             from ..crypto import verify_sig
 
-            for pub, sig, msg in triples:
-                verdicts[(pub, sig, msg)] = verify_sig(pub, sig, msg)
+            with tracer.span("crypto.sigbatch.cpu",
+                             n_sigs=len(triples)):
+                for pub, sig, msg in triples:
+                    verdicts[(pub, sig, msg)] = verify_sig(pub, sig, msg)
         return verdicts
 
     def make_cached_verify(self, verdicts):

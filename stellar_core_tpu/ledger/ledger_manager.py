@@ -38,8 +38,14 @@ class LedgerManager:
         self.metrics = app.metrics
         # per-phase breakdown of the most recent close (ms), plus
         # cumulative phase timers in the metrics registry — the
-        # observability the async merge pipeline is judged by
+        # observability the async merge pipeline is judged by.  Timing
+        # comes from the flight recorder's spans (utils/tracing.py), so
+        # the same measurement feeds this dict, the span ring, the
+        # watchdog, and the Prometheus exposition.
         self.last_close_phases: dict = {}
+        # per-op-type apply cost of the most recent close (ms), the
+        # attribution ROADMAP item 7 asks for (payment vs. DEX crossing)
+        self.last_apply_op_costs: dict = {}
 
     # -- genesis / load ----------------------------------------------------
 
@@ -121,16 +127,27 @@ class LedgerManager:
         """ref closeLedger :669-933."""
         from ..utils.logging import LogSlowExecution
 
+        tracer = self.app.tracer
         with self.metrics.timer("ledger.ledger.close").time_scope(), \
                 LogSlowExecution(f"closeLedger {close_data.ledger_seq}",
                                  threshold_seconds=2.0):
-            self._close_ledger_inner(close_data)
+            root = None
+            try:
+                with tracer.span("ledger.close",
+                                 ledger=close_data.ledger_seq) as root:
+                    self._close_ledger_inner(close_data)
+            finally:
+                # seal the close's span tree into the ring EVEN when the
+                # close raised — a failed close's spans (root included)
+                # must not leak into the next close's record; the
+                # slow-close watchdog fires here (persists Chrome-trace
+                # JSON + one summary line)
+                if root is not None:
+                    tracer.commit_close(close_data.ledger_seq, root)
 
-    def _phase(self, phases: dict, name: str, t0: float,
-               t1: float) -> None:
-        ms = (t1 - t0) * 1000.0
-        phases[name] = phases.get(name, 0.0) + ms
-        self.metrics.timer(f"ledger.close.phase.{name}").update(t1 - t0)
+    def _phase(self, phases: dict, name: str, seconds: float) -> None:
+        phases[name] = phases.get(name, 0.0) + seconds * 1000.0
+        self.metrics.timer(f"ledger.close.phase.{name}").update(seconds)
 
     def _close_ledger_inner(self, close_data: LedgerCloseData) -> None:
         prev_header = self.root.header()
@@ -143,10 +160,11 @@ class LedgerManager:
             raise RuntimeError("tx set prev hash mismatch")
         sv = close_data.close_value
 
-        from time import perf_counter
+        from ..utils import tracing
 
+        tracer = self.app.tracer
         phases: dict = {}
-        t_close0 = perf_counter()
+        total_sw = tracing.stopwatch().__enter__()
 
         with LedgerTxn(self.root) as ltx:
             # open the new ledger: bump seq, set close-time scpValue
@@ -162,36 +180,44 @@ class LedgerManager:
             # bulk-load the entries this set will touch before the apply
             # loops go key-by-key (ref LedgerTxnRoot::prefetch fed by
             # insertKeysForFeeProcessing/insertLedgerKeysToPrefetch)
-            prefetch_keys: set = set()
-            for frame in apply_order:
-                prefetch_keys.update(frame.keys_to_prefetch())
-            self.root.prefetch(prefetch_keys)
+            with tracer.span("ledger.close.prefetch") as sp:
+                prefetch_keys: set = set()
+                for frame in apply_order:
+                    prefetch_keys.update(frame.keys_to_prefetch())
+                self.root.prefetch(prefetch_keys)
+            self._phase(phases, "prefetch", sp.seconds)
 
             # phase 0: batched signature verification on device (P5)
-            t0 = perf_counter()
-            verdicts = tx_set.prevalidate_signatures(
-                use_device=self.app.config.CRYPTO_BACKEND == "tpu")
-            verify = tx_set.make_cached_verify(verdicts)
-            self._phase(phases, "verify", t0, perf_counter())
+            with tracer.span("ledger.close.verify") as sp:
+                verdicts = tx_set.prevalidate_signatures(
+                    use_device=self.app.config.CRYPTO_BACKEND == "tpu",
+                    tracer=tracer)
+                verify = tx_set.make_cached_verify(verdicts)
+            self._phase(phases, "verify", sp.seconds)
 
             # phase 1: fees + seqnums for every tx, in apply order
             # (ref processFeesSeqNums :1164)
             fee_changes: List[object] = []
             base_fee = prev_header.baseFee
-            t0 = perf_counter()
-            with self.metrics.timer(
-                    "ledger.transaction.fee").time_scope():
+            with tracer.span("ledger.close.fee") as sp, \
+                    self.metrics.timer(
+                        "ledger.transaction.fee").time_scope():
                 for frame in apply_order:
                     fee_changes.append(
                         frame.process_fee_seq_num(ltx, base_fee))
-            self._phase(phases, "fee", t0, perf_counter())
+            self._phase(phases, "fee", sp.seconds)
 
             # phase 2: apply transactions (ref applyTransactions :1297)
+            # with per-operation-type cost attribution: frame.apply's op
+            # loop feeds the collector, and the totals become synthetic
+            # sub-spans of the apply span (payment vs. DEX crossing —
+            # the attribution gap of ROADMAP item 7)
             tx_result_metas: List[object] = []
             result_pairs: List[object] = []
-            t0 = perf_counter()
-            with self.metrics.timer(
-                    "ledger.transaction.apply").time_scope():
+            with tracer.span("ledger.close.apply") as sp_apply, \
+                    self.metrics.timer(
+                        "ledger.transaction.apply").time_scope(), \
+                    tracing.collect_op_costs() as op_costs:
                 for i, frame in enumerate(apply_order):
                     ok, result, meta = frame.apply(
                         ltx, verify=verify,
@@ -203,7 +229,19 @@ class LedgerManager:
                         result=pair,
                         feeProcessing=fee_changes[i],
                         txApplyProcessing=meta))
-            self._phase(phases, "apply", t0, perf_counter())
+            self._phase(phases, "apply", sp_apply.seconds)
+            op_ms: dict = {}
+            cursor = sp_apply.t0
+            for name in sorted(op_costs.costs):
+                total_s, count = op_costs.costs[name]
+                op_ms[name] = round(total_s * 1000.0, 3)
+                tracer.aggregate_span(
+                    f"ledger.apply.op.{name}",
+                    sp_apply.span_id or None, cursor, total_s,
+                    count=count)
+                cursor += total_s
+            phases["apply_ops"] = op_ms
+            self.last_apply_op_costs = op_ms
 
             # phase 3: upgrades — each validated against local policy
             # before applying; invalid remote upgrades are skipped, not
@@ -212,40 +250,43 @@ class LedgerManager:
             from ..herder.upgrades import VALID, is_valid_for_apply
 
             upgrade_metas: List[object] = []
-            for raw in sv.upgrades:
-                validity, upgrade = is_valid_for_apply(
-                    raw, ltx.header(), self.app.config)
-                if validity != VALID:
-                    continue
-                with LedgerTxn(ltx) as ultx:
-                    hdr = self._apply_upgrade(ultx.header(), upgrade)
-                    ultx.set_header(hdr)
-                    changes = ultx.changes()
-                    ultx.commit()
-                upgrade_metas.append(T.UpgradeEntryMeta.make(
-                    upgrade=upgrade, changes=changes))
+            with tracer.span("ledger.close.upgrades") as sp:
+                for raw in sv.upgrades:
+                    validity, upgrade = is_valid_for_apply(
+                        raw, ltx.header(), self.app.config)
+                    if validity != VALID:
+                        continue
+                    with LedgerTxn(ltx) as ultx:
+                        hdr = self._apply_upgrade(ultx.header(), upgrade)
+                        ultx.set_header(hdr)
+                        changes = ultx.changes()
+                        ultx.commit()
+                    upgrade_metas.append(T.UpgradeEntryMeta.make(
+                        upgrade=upgrade, changes=changes))
+            self._phase(phases, "upgrades", sp.seconds)
 
             # phase 4: seal the header
-            t0 = perf_counter()
-            result_set = T.TransactionResultSet.make(results=result_pairs)
-            tx_result_hash = xdr_sha256(T.TransactionResultSet, result_set)
-            sealed = ltx.header()._replace(
-                txSetResultHash=tx_result_hash,
-            )
-            ltx.set_header(sealed)
-            self._phase(phases, "hash", t0, perf_counter())
+            with tracer.span("ledger.close.hash") as sp:
+                result_set = T.TransactionResultSet.make(
+                    results=result_pairs)
+                tx_result_hash = xdr_sha256(T.TransactionResultSet,
+                                            result_set)
+                sealed = ltx.header()._replace(
+                    txSetResultHash=tx_result_hash,
+                )
+                ltx.set_header(sealed)
+            self._phase(phases, "hash", sp.seconds)
 
             # phase 5: bucket list — state commitment.  spill_wait /
             # bucket-hash sub-phases come from the merge pipeline's own
             # accounting (deltas over BucketList.stats)
             bl = self.app.bucket_manager.bucket_list
             stats0 = dict(bl.stats)
-            t0 = perf_counter()
-            bucket_changes = self._collect_changes(ltx)
-            bucket_hash = self.app.bucket_manager.add_batch(
-                close_data.ledger_seq, bucket_changes)
-            t1 = perf_counter()
-            self._phase(phases, "bucket", t0, t1)
+            with tracer.span("ledger.close.bucket") as sp:
+                bucket_changes = self._collect_changes(ltx)
+                bucket_hash = self.app.bucket_manager.add_batch(
+                    close_data.ledger_seq, bucket_changes)
+            self._phase(phases, "bucket", sp.seconds)
             phases["spill_wait"] = round(
                 (bl.stats["spill_wait_s"] - stats0["spill_wait_s"])
                 * 1000.0, 3)
@@ -257,44 +298,68 @@ class LedgerManager:
                 self.metrics.counter(
                     "bucket.merge.sync-fallback").inc(sync_fb)
 
-            t0 = perf_counter()
-            sealed = ltx.header()._replace(bucketListHash=bucket_hash)
-            sealed = self._update_skip_list(sealed)
-            ltx.set_header(sealed)
+            with tracer.span("ledger.close.seal") as sp_seal:
+                sealed = ltx.header()._replace(bucketListHash=bucket_hash)
+                sealed = self._update_skip_list(sealed)
+                ltx.set_header(sealed)
 
-            # phase 6: persist tx history rows (SQL, same commit)
-            self._store_tx_history(close_data.ledger_seq, apply_order,
-                                   tx_result_metas)
-            ltx.commit()
+                # phase 6: persist tx history rows (SQL, same commit)
+                self._store_tx_history(close_data.ledger_seq, apply_order,
+                                       tx_result_metas)
+                ltx.commit()
 
-        # the buckets now cover this close's delta: bucket-mode reads no
-        # longer need the commit's sql-ahead overlay copies
-        self.root.note_bucket_applied(kb for kb, _, _ in bucket_changes)
-        new_header = self.root.header()
-        self._lcl_hash = xdr_sha256(T.LedgerHeader, new_header)
-        self._store_lcl(new_header)
-        self._store_bucket_state()
-        self._phase(phases, "commit", t0, perf_counter())
+        with tracer.span("ledger.close.commit") as sp:
+            # the buckets now cover this close's delta: bucket-mode reads
+            # no longer need the commit's sql-ahead overlay copies
+            self.root.note_bucket_applied(
+                kb for kb, _, _ in bucket_changes)
+            new_header = self.root.header()
+            self._lcl_hash = xdr_sha256(T.LedgerHeader, new_header)
+            self._store_lcl(new_header)
+            self._store_bucket_state()
+        self._phase(phases, "commit", sp_seal.seconds + sp.seconds)
         self.metrics.counter("ledger.ledger.count").set_count(
             new_header.ledgerSeq)
         # history: queue + publish checkpoints (ref closeLedger :890-899 —
         # queueing is crash-safe because the header row committed above in
         # the same SQL database)
-        hm = self.app.history_manager
-        if hm is not None:
-            hm.maybe_queue_history_checkpoint(new_header.ledgerSeq)
-            hm.publish_queued_history()
-        # meta stream for downstream consumers
-        self.app.emit_ledger_close_meta(
-            new_header, tx_set, tx_result_metas, upgrade_metas)
-        t0 = perf_counter()
-        self._post_close_gc(new_header.ledgerSeq)
-        self._phase(phases, "gc", t0, perf_counter())
-        phases["total"] = round((perf_counter() - t_close0) * 1000.0, 3)
+        with tracer.span("ledger.close.meta") as sp:
+            hm = self.app.history_manager
+            if hm is not None:
+                hm.maybe_queue_history_checkpoint(new_header.ledgerSeq)
+                hm.publish_queued_history()
+            # meta stream for downstream consumers
+            self.app.emit_ledger_close_meta(
+                new_header, tx_set, tx_result_metas, upgrade_metas)
+        self._phase(phases, "meta", sp.seconds)
+        # test hook: a deliberately slowed close to exercise the
+        # slow-close watchdog end to end.  Placed AFTER the bucket phase
+        # so merges staged on the worker pool this close deterministically
+        # finish (and record their spans) before the close commits —
+        # exactly what the cross-thread parenting test needs; the span
+        # makes the persisted trace attribute the delay honestly.
+        delay = self.app.config.ARTIFICIALLY_SLEEP_IN_CLOSE_FOR_TESTING
+        if delay > 0:
+            from time import sleep
+
+            with tracer.span("ledger.close.test_delay", seconds=delay):
+                sleep(delay)
+        with tracer.span("ledger.close.gc") as sp:
+            self._post_close_gc(new_header.ledgerSeq)
+        self._phase(phases, "gc", sp.seconds)
+        total_sw.__exit__()
+        phases["total"] = round(total_sw.seconds * 1000.0, 3)
         phases["sync_fallback_merges"] = sync_fb
         self.last_close_phases = {
             k: (round(v, 3) if isinstance(v, float) else v)
             for k, v in phases.items()}
+        from ..utils.logging import get_logger
+
+        get_logger("Ledger").debug(
+            "closed ledger %d: %d txs in %.1fms (apply %.1fms, "
+            "bucket %.1fms)", close_data.ledger_seq, len(apply_order),
+            phases["total"], phases.get("apply", 0.0),
+            phases.get("bucket", 0.0))
 
     def _post_close_gc(self, seq: int) -> None:
         """DEFERRED_GC: young-gen collection after every close, full

@@ -41,6 +41,17 @@ class Application:
             if config.SCP_TALLY_BACKEND == "auto":
                 config.SCP_TALLY_BACKEND = "tensor" if alive else "host"
         self.metrics = MetricsRegistry(clock)
+        # flight recorder: span ring + slow-close watchdog (utils/tracing)
+        from ..utils.tracing import Tracer
+
+        self.tracer = Tracer(
+            enabled=config.TRACING_ENABLED,
+            ring_closes=config.TRACE_RING_CLOSES,
+            slow_close_threshold=(
+                config.SLOW_CLOSE_THRESHOLD_SECONDS
+                if config.SLOW_CLOSE_THRESHOLD_SECONDS > 0 else None),
+            trace_dir=config.TRACE_DIR,
+            metrics=self.metrics)
         self.scheduler = Scheduler(clock)
         from ..database import Database
 

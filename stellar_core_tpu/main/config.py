@@ -166,6 +166,24 @@ class Config:
         # trip (a mid-close gen2 cycle costs >1s at 1000-tx closes)
         self.DEFERRED_GC: bool = kw.get("DEFERRED_GC", True)
 
+        # flight recorder (utils/tracing.py): hierarchical span tracing
+        # over the close path.  Disabled tracing still measures the
+        # per-phase close breakdown; it just records no spans.
+        self.TRACING_ENABLED: bool = kw.get("TRACING_ENABLED", True)
+        # how many whole closes the span ring retains (/trace?ledger=N)
+        self.TRACE_RING_CLOSES: int = kw.get("TRACE_RING_CLOSES", 8)
+        # slow-close watchdog: a close slower than this persists its full
+        # span tree as Chrome trace_event JSON into TRACE_DIR and logs a
+        # one-line summary (<= 0 disables the watchdog)
+        self.SLOW_CLOSE_THRESHOLD_SECONDS: float = kw.get(
+            "SLOW_CLOSE_THRESHOLD_SECONDS", 2.0)
+        self.TRACE_DIR: str = kw.get("TRACE_DIR", "traces")
+        # test hook: sleep this long inside every close (span
+        # "ledger.close.test_delay") so the watchdog path is testable
+        # without a genuinely pathological workload
+        self.ARTIFICIALLY_SLEEP_IN_CLOSE_FOR_TESTING: float = kw.get(
+            "ARTIFICIALLY_SLEEP_IN_CLOSE_FOR_TESTING", 0.0)
+
         # invariants
         self.INVARIANT_CHECKS: List[str] = kw.get("INVARIANT_CHECKS", [])
 
@@ -203,6 +221,11 @@ class Config:
             raise ConfigError("MAX_SLOTS_TO_REMEMBER must be >= 1")
         if self.MAX_CONCURRENT_SUBPROCESSES < 1:
             raise ConfigError("MAX_CONCURRENT_SUBPROCESSES must be >= 1")
+        if self.TRACE_RING_CLOSES < 1:
+            raise ConfigError("TRACE_RING_CLOSES must be >= 1")
+        if self.ARTIFICIALLY_SLEEP_IN_CLOSE_FOR_TESTING < 0:
+            raise ConfigError(
+                "ARTIFICIALLY_SLEEP_IN_CLOSE_FOR_TESTING must be >= 0")
         if self.CRYPTO_BACKEND not in ("cpu", "tpu", "auto"):
             raise ConfigError(
                 f"unknown CRYPTO_BACKEND {self.CRYPTO_BACKEND!r}")
@@ -356,6 +379,10 @@ def test_config(n: int = 0, **kw) -> Config:
         # process-global and one multi-app pytest process must not have
         # collection disabled by the first test app
         DEFERRED_GC=False,
+        # the slow-close watchdog stays off in suites (a loaded CI worker
+        # crossing the threshold would litter trace files in the cwd);
+        # watchdog tests opt in with an explicit threshold + TRACE_DIR
+        SLOW_CLOSE_THRESHOLD_SECONDS=0.0,
         # tests pin the host tiers: "auto" would spawn one device-probe
         # subprocess per process, and the suite runs on CPU anyway;
         # device-path tests opt in explicitly

@@ -16,6 +16,16 @@ import urllib.parse
 from typing import Callable, Dict, Optional
 
 
+class RawBody:
+    """A non-JSON response body (Prometheus text exposition, trace JSON
+    downloads): handlers return one in place of a dict and _respond
+    sends it verbatim with its content type."""
+
+    def __init__(self, data: bytes, content_type: str):
+        self.data = data
+        self.content_type = content_type
+
+
 class CommandHandler:
     """Route registry + implementations (ref CommandHandler::CommandHandler
     registering handlers :89-129)."""
@@ -36,6 +46,8 @@ class CommandHandler:
             "bans": self.bans,
             "unban": self.unban,
             "generateload": self.generateload,
+            "trace": self.trace,
+            "trace/summary": self.trace_summary,
         }
 
     def handle(self, path: str, params: Dict[str, str]) -> tuple:
@@ -54,6 +66,16 @@ class CommandHandler:
         return 200, {"info": self.app.get_json_info()}
 
     def metrics(self, params):
+        # ?format=prometheus: text exposition of the registry (plus the
+        # flight recorder's span-derived timers, which live in the
+        # registry as span.* Timers).  The default JSON body below is
+        # untouched — existing consumers see identical bytes.
+        if params.get("format") == "prometheus":
+            from ..utils.metrics import render_prometheus
+
+            return 200, RawBody(
+                render_prometheus(self.app.metrics).encode(),
+                "text/plain; version=0.0.4; charset=utf-8")
         snap = self.app.metrics.snapshot()
         root = self.app.ledger_manager.root
         snap["ledger.prefetch.hit-rate"] = round(
@@ -301,12 +323,64 @@ class CommandHandler:
         return 200, {"unbanned": node}
 
     def log_level(self, params):
+        """ll?level=debug[&partition=SCP] — runtime per-partition log
+        control (ref CommandHandler.cpp:113).  Unknown partitions/levels
+        are a 400, not a silent fallback to the Default partition."""
         from ..utils import logging as L
 
         level = params.get("level")
+        partition = params.get("partition")
+        if partition is not None and partition not in L.PARTITIONS:
+            return 400, {"error": f"unknown log partition {partition!r}",
+                         "partitions": list(L.PARTITIONS)}
         if level:
-            L.set_log_level(level, params.get("partition"))
+            try:
+                L.set_log_level(level, partition)
+            except ValueError as e:
+                return 400, {"error": str(e)}
         return 200, {"levels": L.get_log_levels()}
+
+    # -- flight recorder (utils/tracing) ------------------------------------
+
+    def trace(self, params):
+        """trace?ledger=N — Chrome trace_event JSON of one retained
+        close (the latest when ledger is omitted); load it in
+        chrome://tracing / Perfetto or tools/trace_view.py."""
+        from ..utils.tracing import chrome_trace
+
+        tracer = self.app.tracer
+        if not tracer.enabled:
+            return 400, {"error": "tracing disabled (TRACING_ENABLED)"}
+        seq = None
+        if "ledger" in params:
+            try:
+                seq = int(params["ledger"])
+            except ValueError:
+                return 400, {"error": "bad ledger param"}
+        rec = tracer.get_close(seq)
+        if rec is None:
+            retained = [r.seq for r in tracer.closes()]
+            return 404, {"error": f"no trace for ledger {seq}",
+                         "retained_closes": retained}
+        return 200, RawBody(
+            json.dumps(chrome_trace(rec), indent=1).encode(),
+            "application/json")
+
+    def trace_summary(self, params):
+        """trace/summary?k=N — top-k self-time spans aggregated over the
+        whole retained close ring."""
+        from ..utils.tracing import summarize_ring
+
+        tracer = self.app.tracer
+        recs = tracer.closes()
+        k = int(params.get("k", "10"))
+        return 200, {
+            "closes_retained": [r.seq for r in recs],
+            "slow_close_traces": [
+                {"ledger": seq, "path": path}
+                for seq, path in tracer.slow_close_traces],
+            "top_spans_by_self_time": summarize_ring(recs, k=k),
+        }
 
 
 class AdminHttpServer:
@@ -360,11 +434,16 @@ class AdminHttpServer:
             status, body = self.handler.handle(parsed.path, params)
         except Exception as e:
             status, body = 400, {"error": str(e)}
-        payload = json.dumps(body, indent=1).encode()
+        if isinstance(body, RawBody):
+            payload = body.data
+            content_type = body.content_type
+        else:
+            payload = json.dumps(body, indent=1).encode()
+            content_type = "application/json"
         reasons = {200: "OK", 400: "Bad Request", 404: "Not Found",
                    500: "Internal Server Error"}
         head = (f"HTTP/1.0 {status} {reasons.get(status, 'OK')}\r\n"
-                f"Content-Type: application/json\r\n"
+                f"Content-Type: {content_type}\r\n"
                 f"Content-Length: {len(payload)}\r\n\r\n").encode()
         try:
             conn.sendall(head + payload)

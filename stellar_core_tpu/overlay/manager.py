@@ -113,6 +113,11 @@ class OverlayManager:
             self.pending_peers.remove(peer)
         self.authenticated[peer.peer_id] = peer
         self.app.metrics.counter("overlay.connection.authenticated").inc()
+        from ..utils.logging import get_logger
+
+        get_logger("Overlay").info(
+            "peer %s authenticated (%d connected)",
+            peer.peer_id.hex()[:8], len(self.authenticated))
         addr = getattr(peer, "remote_addr", None)
         if addr is not None and self.peer_manager is not None:
             self.peer_manager.on_connect_success(*addr)
@@ -176,21 +181,24 @@ class OverlayManager:
     # -- inbound dispatch (called from Peer) --------------------------------
 
     def recv_transaction(self, peer, env) -> None:
-        msg = O.StellarMessage.make(O.MessageType.TRANSACTION, env)
-        if not self.floodgate.add_record(msg, peer.peer_id,
-                                         self._ledger_seq()):
-            return
-        res = self.app.herder.tx_queue.try_add(env)
-        if res == 0:  # pending: forward
-            self.broadcast_message(msg)
+        with self.app.tracer.span("overlay.recv.transaction"):
+            msg = O.StellarMessage.make(O.MessageType.TRANSACTION, env)
+            if not self.floodgate.add_record(msg, peer.peer_id,
+                                             self._ledger_seq()):
+                return
+            res = self.app.herder.tx_queue.try_add(env)
+            if res == 0:  # pending: forward
+                self.broadcast_message(msg)
 
     def recv_scp_message(self, peer, scp_env) -> None:
-        msg = O.StellarMessage.make(O.MessageType.SCP_MESSAGE, scp_env)
-        if not self.floodgate.add_record(msg, peer.peer_id,
-                                         self._ledger_seq()):
-            return
-        self.app.herder.recv_scp_envelope(scp_env)
-        self.broadcast_message(msg)
+        with self.app.tracer.span("overlay.recv.scp"):
+            msg = O.StellarMessage.make(O.MessageType.SCP_MESSAGE,
+                                        scp_env)
+            if not self.floodgate.add_record(msg, peer.peer_id,
+                                             self._ledger_seq()):
+                return
+            self.app.herder.recv_scp_envelope(scp_env)
+            self.broadcast_message(msg)
 
     def recv_get_tx_set(self, peer, h: bytes) -> None:
         ts = self.app.herder.pending_envelopes.get_tx_set(h)

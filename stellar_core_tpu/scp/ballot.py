@@ -60,8 +60,14 @@ class BallotProtocol:
     # -- envelope processing ----------------------------------------------
 
     def process_envelope(self, envelope, self_: bool = False):
+        from ..utils.tracing import tracer_of
         from .slot import EnvelopeState
 
+        with tracer_of(self.driver).span("scp.ballot.envelope",
+                                         slot=self.slot.slot_index):
+            return self._process_envelope(envelope, self_, EnvelopeState)
+
+    def _process_envelope(self, envelope, self_, EnvelopeState):
         st = envelope.statement
         if not self._statement_sane(st, self_):
             return EnvelopeState.INVALID
@@ -116,6 +122,13 @@ class BallotProtocol:
     # -- external triggers -------------------------------------------------
 
     def bump_state(self, value: bytes, force_or_n) -> bool:
+        from ..utils.tracing import tracer_of
+
+        with tracer_of(self.driver).span("scp.ballot.bump",
+                                         slot=self.slot.slot_index):
+            return self._bump_state(value, force_or_n)
+
+    def _bump_state(self, value: bytes, force_or_n) -> bool:
         if isinstance(force_or_n, bool):
             if not force_or_n and self.current is not None:
                 return False

@@ -141,8 +141,14 @@ class NominationProtocol:
     # -- envelope processing -----------------------------------------------
 
     def process_envelope(self, envelope):
+        from ..utils.tracing import tracer_of
         from .slot import EnvelopeState
 
+        with tracer_of(self.driver).span("scp.nominate.envelope",
+                                         slot=self.slot.slot_index):
+            return self._process_envelope(envelope, EnvelopeState)
+
+    def _process_envelope(self, envelope, EnvelopeState):
         st = envelope.statement
         nom = st.pledges.value
         if not self._is_newer(node_of(st), nom):
@@ -221,6 +227,15 @@ class NominationProtocol:
 
     def nominate(self, value: bytes, previous_value: bytes,
                  timedout: bool) -> bool:
+        from ..utils.tracing import tracer_of
+
+        with tracer_of(self.driver).span(
+                "scp.nominate.round", slot=self.slot.slot_index,
+                round=self.round_number + 1, timedout=timedout):
+            return self._nominate(value, previous_value, timedout)
+
+    def _nominate(self, value: bytes, previous_value: bytes,
+                  timedout: bool) -> bool:
         if self.candidates:
             return False  # already have a candidate; stop proposing
         if timedout:

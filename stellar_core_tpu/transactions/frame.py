@@ -21,6 +21,19 @@ from .signature_checker import SignatureChecker, account_signers
 
 TC = T.TransactionResultCode
 
+# OperationType value -> lowercase name ("payment", "manage_sell_offer")
+# for the flight recorder's per-op-type apply cost attribution
+_OP_TYPE_NAMES = {
+    getattr(T.OperationType, n): n.lower()
+    for n in dir(T.OperationType)
+    if not n.startswith("_")
+    and isinstance(getattr(T.OperationType, n), int)
+}
+
+
+def op_type_name(op_type: int) -> str:
+    return _OP_TYPE_NAMES.get(op_type, f"op_{op_type}")
+
 # ref TransactionFrame.h ValidationType: how far commonValid got — at
 # apply, cv >= kInvalidUpdateSeqNum still consumes the sequence number
 VT_INVALID = 0            # kInvalid
@@ -509,13 +522,25 @@ class TransactionFrame:
                     self._make_result(res, ops_sig_results or []),
                     _meta([], changes_before))
 
+        # per-op-type cost attribution: active only inside a close's
+        # apply phase (LedgerManager installs the collector); the
+        # disabled path costs one thread-local read per transaction
+        from ..utils import tracing
+
+        op_costs = tracing.op_collector()
         with LedgerTxn(ltx) as tx_ltx:
             op_results: List[object] = []
             op_metas: List[object] = []
             success = True
             for opf in self.op_frames:
                 with LedgerTxn(tx_ltx) as op_ltx:
-                    ok = opf.apply(op_ltx, checker)
+                    if op_costs is None:
+                        ok = opf.apply(op_ltx, checker)
+                    else:
+                        with tracing.stopwatch() as sw:
+                            ok = opf.apply(op_ltx, checker)
+                        op_costs.add(op_type_name(opf.op.body.type),
+                                     sw.seconds)
                     if ok:
                         if invariant_check is not None:
                             invariant_check(op_ltx, opf, True)

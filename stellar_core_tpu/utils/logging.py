@@ -29,8 +29,14 @@ def get_logger(partition: str) -> logging.Logger:
 
 
 def set_log_level(level: str, partition: Optional[str] = None) -> None:
-    """Set one partition's level, or all when partition is None."""
-    lvl = getattr(logging, level.upper())
+    """Set one partition's level, or all when partition is None.
+    Raises ValueError on an unknown level or partition — the runtime
+    ``ll`` endpoint must not silently retarget the Default partition."""
+    lvl = getattr(logging, level.upper(), None)
+    if not isinstance(lvl, int):
+        raise ValueError(f"unknown log level {level!r}")
+    if partition is not None and partition not in PARTITIONS:
+        raise ValueError(f"unknown log partition {partition!r}")
     targets = [partition] if partition else PARTITIONS
     for p in targets:
         get_logger(p).setLevel(lvl)

@@ -27,7 +27,12 @@ class Counter:
 
 
 class Meter:
-    """Event rate tracker (1m EWMA + total count)."""
+    """Event rate tracker (1m EWMA + total count).
+
+    ``one_minute_rate`` decays on READ: an idle meter's rate tends to 0
+    with the time since its last mark instead of freezing at the last
+    instantaneous value (medida's tickIfNecessary, folded into the
+    getter so there is no tick thread)."""
 
     def __init__(self, clock=None):
         self.count = 0
@@ -50,18 +55,33 @@ class Meter:
 
     @property
     def one_minute_rate(self) -> float:
-        return self._rate
+        if self._last is None:
+            return 0.0
+        idle = self._now() - self._last
+        if idle <= 0:
+            return self._rate
+        return self._rate * math.exp(-idle / 60.0)
 
 
 class Histogram:
-    """Reservoir-free streaming histogram (count/min/max/mean/percentiles
-    over a sliding sample of 1028 like medida's uniform sample)."""
+    """Streaming histogram (count/min/max/mean/percentiles) over a
+    DETERMINISTIC stride-decimation reservoir.
+
+    Medida keeps a uniform random sample; the randomness made two
+    identically-driven registries produce different snapshots (and
+    tripped the spirit of detlint's determinism discipline).  Instead:
+    accept every ``stride``-th update; when the buffer fills, drop every
+    other retained sample and double the stride.  The reservoir is a
+    uniform systematic sample of the whole update history, bounded to
+    [MAX_SAMPLES/2, MAX_SAMPLES], and a pure function of the update
+    sequence."""
 
     MAX_SAMPLES = 1028
 
     def __init__(self):
         self.count = 0
         self._samples: List[float] = []
+        self._stride = 1
         self.min = math.inf
         self.max = -math.inf
         self._sum = 0.0
@@ -71,14 +91,13 @@ class Histogram:
         self._sum += v
         self.min = min(self.min, v)
         self.max = max(self.max, v)
-        if len(self._samples) < self.MAX_SAMPLES:
+        if (self.count - 1) % self._stride == 0:
             self._samples.append(v)
-        else:
-            import random
-
-            i = random.randrange(self.count)
-            if i < self.MAX_SAMPLES:
-                self._samples[i] = v
+            if len(self._samples) >= self.MAX_SAMPLES:
+                # keep even positions: exactly the samples a doubled
+                # stride would have accepted from the start
+                del self._samples[1::2]
+                self._stride *= 2
 
     @property
     def mean(self) -> float:
@@ -173,3 +192,53 @@ class MetricsRegistry:
     def reset(self) -> None:
         """MetricResetter equivalent for tests."""
         self._metrics.clear()
+
+
+# -- Prometheus exposition ---------------------------------------------------
+
+def _prom_name(name: str) -> str:
+    """Dotted medida-style names -> a legal Prometheus metric name."""
+    import re
+
+    out = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if not re.match(r"[a-zA-Z_:]", out):
+        out = "_" + out
+    return out
+
+
+def render_prometheus(registry: MetricsRegistry) -> str:
+    """Text exposition format (version 0.0.4) of the registry: counters
+    as ``counter``, meters as count + 1m-rate gauge, timers/histograms
+    as ``summary`` with quantile labels — the shape Prometheus's
+    text-format parser and promtool both accept.  Span-derived timers
+    (``span.*``, fed per close by the flight recorder) ride along as
+    ordinary registry timers."""
+    lines: List[str] = []
+    for name, m in sorted(registry._metrics.items()):
+        pname = _prom_name(name)
+        if isinstance(m, Counter):
+            lines.append(f"# TYPE {pname} counter")
+            lines.append(f"{pname} {m.count}")
+        elif isinstance(m, Timer):
+            _render_summary(lines, pname + "_seconds", m)
+            rname = pname + "_rate1m"
+            lines.append(f"# TYPE {rname} gauge")
+            lines.append(f"{rname} {m.meter.one_minute_rate:.6g}")
+        elif isinstance(m, Meter):
+            lines.append(f"# TYPE {pname}_total counter")
+            lines.append(f"{pname}_total {m.count}")
+            rname = pname + "_rate1m"
+            lines.append(f"# TYPE {rname} gauge")
+            lines.append(f"{rname} {m.one_minute_rate:.6g}")
+        elif isinstance(m, Histogram):
+            _render_summary(lines, pname, m)
+    return "\n".join(lines) + "\n"
+
+
+def _render_summary(lines: List[str], pname: str, h: Histogram) -> None:
+    s = h.summary()
+    lines.append(f"# TYPE {pname} summary")
+    for q, key in (("0.5", "p50"), ("0.75", "p75"), ("0.99", "p99")):
+        lines.append(f'{pname}{{quantile="{q}"}} {s[key]:.6g}')
+    lines.append(f"{pname}_sum {h.mean * h.count:.6g}")
+    lines.append(f"{pname}_count {h.count}")

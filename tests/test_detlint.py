@@ -45,6 +45,48 @@ def test_baseline_entries_are_justified():
             f"baseline entry without a real justification: {entry}")
 
 
+def test_baseline_shrank_after_sanctioned_tracing_api():
+    """ISSUE 4 satellite: the flight recorder's sanctioned timing APIs
+    (utils.tracing.span/stopwatch, Timer.time_scope) replaced every raw
+    perf_counter read in consensus modules, so the 18 det-wallclock
+    baseline entries of ISSUE 3 are gone.  The baseline must only ever
+    shrink or stay equal from here."""
+    assert len(load_baseline()) == 0
+
+
+def test_sanctioned_instrumentation_needs_no_baseline():
+    """Instrumenting a consensus module through the sanctioned APIs
+    produces zero findings — adding a span must never require a new
+    det-wallclock baseline entry."""
+    src = '''
+from stellar_core_tpu.utils.tracing import span, stopwatch
+
+
+def close_ledger(tracer, metrics, stats):
+    with tracer.span("ledger.close"):
+        with metrics.timer("ledger.ledger.close").time_scope():
+            pass
+    with stopwatch() as sw:
+        pass
+    stats["spill_wait_s"] += sw.seconds
+'''
+    assert not lint_sources({TALLY: src})
+
+
+def test_sanctioned_call_matcher():
+    from tools.lint.determinism import is_sanctioned_timing_call
+
+    assert is_sanctioned_timing_call(
+        "stellar_core_tpu.utils.tracing.span")
+    assert is_sanctioned_timing_call(
+        "stellar_core_tpu.utils.tracing.stopwatch")
+    assert is_sanctioned_timing_call("tracing.span")
+    assert is_sanctioned_timing_call("self.metrics.timer.time_scope")
+    assert not is_sanctioned_timing_call("time.perf_counter")
+    assert not is_sanctioned_timing_call("time.time")
+    assert not is_sanctioned_timing_call(None)
+
+
 def test_strict_cli_exits_zero_on_clean_repo():
     proc = subprocess.run(
         [sys.executable, "-m", "tools.lint", "--strict"],

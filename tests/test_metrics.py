@@ -1,0 +1,184 @@
+"""Metrics registry coverage (ISSUE 4 satellite): type-collision
+assert, snapshot key ordering, time_scope on exception, the
+deterministic stride-decimation Histogram reservoir, Meter decay on
+read, and the Prometheus exposition round-trip."""
+import re
+
+import pytest
+
+from stellar_core_tpu.utils.metrics import (
+    Histogram, Meter, MetricsRegistry, render_prometheus,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def now(self) -> float:
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_type_collision_asserts():
+    reg = MetricsRegistry()
+    reg.counter("scp.envelope.receive")
+    with pytest.raises(AssertionError):
+        reg.timer("scp.envelope.receive")
+    with pytest.raises(AssertionError):
+        reg.meter("scp.envelope.receive")
+
+
+def test_snapshot_key_ordering_is_stable():
+    reg = MetricsRegistry()
+    for name in ("z.last.metric", "a.first.metric", "m.middle.metric",
+                 "a.first.aaa"):
+        reg.counter(name).inc()
+    keys = list(reg.snapshot())
+    assert keys == sorted(keys)
+    # registration order must not matter
+    reg2 = MetricsRegistry()
+    for name in ("a.first.aaa", "m.middle.metric", "a.first.metric",
+                 "z.last.metric"):
+        reg2.counter(name).inc()
+    assert list(reg2.snapshot()) == keys
+
+
+def test_time_scope_records_on_exception():
+    reg = MetricsRegistry()
+    t = reg.timer("ledger.ledger.close")
+    with pytest.raises(RuntimeError):
+        with t.time_scope():
+            raise RuntimeError("close blew up")
+    assert t.count == 1
+    assert t.max >= 0
+
+
+# ---------------------------------------------------------------------------
+# deterministic histogram reservoir
+# ---------------------------------------------------------------------------
+
+def test_histogram_reservoir_is_deterministic_and_bounded():
+    h1, h2 = Histogram(), Histogram()
+    for i in range(10_000):
+        v = float((i * 37) % 1000)
+        h1.update(v)
+        h2.update(v)
+    assert h1.summary() == h2.summary()
+    assert len(h1._samples) <= Histogram.MAX_SAMPLES
+    assert len(h1._samples) >= Histogram.MAX_SAMPLES // 2
+    assert h1.count == 10_000
+
+
+def test_histogram_stride_decimation_keeps_systematic_sample():
+    h = Histogram()
+    n = 5000
+    for i in range(n):
+        h.update(float(i))
+    # the reservoir is exactly the multiples of the final stride
+    assert h._samples == [float(i) for i in range(0, n, h._stride)]
+    # percentiles stay sane on the systematic sample
+    assert h.summary()["p50"] == pytest.approx(n / 2, rel=0.05)
+    assert h.min == 0.0 and h.max == float(n - 1)
+
+
+def test_histogram_module_has_no_random_import():
+    import inspect
+
+    import stellar_core_tpu.utils.metrics as M
+
+    src = inspect.getsource(M)
+    assert "import random" not in src
+
+
+# ---------------------------------------------------------------------------
+# meter decay on read
+# ---------------------------------------------------------------------------
+
+def test_meter_rate_decays_to_zero_when_idle():
+    clk = FakeClock()
+    m = Meter(clock=clk)
+    for _ in range(100):
+        clk.t += 1.0
+        m.mark()
+    busy_rate = m.one_minute_rate
+    assert busy_rate > 0.5  # ~1/s
+    clk.t += 60.0
+    decayed = m.one_minute_rate
+    assert decayed < busy_rate * 0.5
+    clk.t += 600.0
+    assert m.one_minute_rate < 1e-4
+    # reading must not mutate: the stored rate recovers on new marks
+    clk.t += 1.0
+    m.mark()
+    assert m.one_minute_rate > 1e-4
+
+
+def test_meter_never_marked_reads_zero():
+    assert Meter(clock=FakeClock()).one_minute_rate == 0.0
+
+
+# ---------------------------------------------------------------------------
+# prometheus exposition round-trip
+# ---------------------------------------------------------------------------
+
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? ([-+0-9.eEinfa]+)$")
+
+
+def _parse(text):
+    """Minimal text-format parser: {name: {labels_str: value}} plus the
+    TYPE declarations."""
+    samples, types = {}, {}
+    for ln in text.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("# TYPE "):
+            _, _, name, typ = ln.split(" ")
+            types[name] = typ
+            continue
+        m = _SAMPLE.match(ln)
+        assert m, f"unparseable exposition line: {ln!r}"
+        samples.setdefault(m.group(1), {})[m.group(2) or ""] = \
+            float(m.group(3))
+    return samples, types
+
+
+def test_prometheus_round_trip():
+    clk = FakeClock()
+    reg = MetricsRegistry(clk)
+    reg.counter("ledger.ledger.count").set_count(41)
+    mt = reg.meter("overlay.message.read")
+    clk.t += 1.0
+    mt.mark(7)
+    tm = reg.timer("ledger.ledger.close")
+    for v in (0.010, 0.020, 0.030):
+        clk.t += 1.0
+        tm.update(v)
+    reg.histogram("herder.pending.txs").update(12.0)
+    text = render_prometheus(reg)
+    samples, types = _parse(text)
+    assert samples["ledger_ledger_count"][""] == 41
+    assert types["ledger_ledger_count"] == "counter"
+    assert samples["overlay_message_read_total"][""] == 7
+    assert types["ledger_ledger_close_seconds"] == "summary"
+    assert samples["ledger_ledger_close_seconds"]['{quantile="0.5"}'] \
+        == pytest.approx(0.020)
+    assert samples["ledger_ledger_close_seconds_count"][""] == 3
+    assert samples["ledger_ledger_close_seconds_sum"][""] == \
+        pytest.approx(0.060, rel=1e-3)
+    assert samples["herder_pending_txs"]['{quantile="0.5"}'] == 12.0
+    # every line parses (the format-level gate)
+    for ln in text.splitlines():
+        if ln:
+            assert ln.startswith("# TYPE ") or _SAMPLE.match(ln)
+
+
+def test_prometheus_name_sanitization():
+    reg = MetricsRegistry()
+    reg.counter("bucket.merge.sync-fallback").inc(3)
+    samples, _ = _parse(render_prometheus(reg))
+    assert samples["bucket_merge_sync_fallback"][""] == 3

@@ -1,0 +1,319 @@
+"""Flight recorder acceptance (ISSUE 4 tentpole).
+
+(1) A deliberately slowed close (the ARTIFICIALLY_SLEEP_IN_CLOSE test
+hook) trips the slow-close watchdog, which persists Chrome trace_event
+JSON; the file is loaded back and validated: nested spans cover >= 95%
+of the close's wall time, and the bucket worker-pool spans parent
+correctly ACROSS THREADS back to the close root.
+(2) /trace, /trace/summary and /metrics?format=prometheus surface the
+same data over the admin API; the default /metrics JSON stays
+byte-identical for existing consumers.
+(3) The span ring's eviction bounds hold under concurrent writers.
+"""
+import json
+import re
+import threading
+
+import pytest
+
+from stellar_core_tpu.main import Application
+from stellar_core_tpu.main import test_config as _test_config
+from stellar_core_tpu.main.http_server import CommandHandler, RawBody
+from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+from stellar_core_tpu.utils.tracing import (
+    Tracer, chrome_trace, summarize_ring,
+)
+
+
+def make_app(**kw):
+    a = Application(VirtualClock(ClockMode.VIRTUAL_TIME),
+                    _test_config(**kw))
+    a.start()
+    return a
+
+
+# ---------------------------------------------------------------------------
+# the watchdog end-to-end: slow close -> persisted chrome trace
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def slow_close(tmp_path_factory):
+    """Close a few normal ledgers, then one deliberately slowed SPILL
+    close; return (app, persisted trace dict, CloseRecord)."""
+    tmp = tmp_path_factory.mktemp("traces")
+    app = make_app(SLOW_CLOSE_THRESHOLD_SECONDS=0.1,
+                   TRACE_DIR=str(tmp))
+    # warm up past genesis; land on an odd seq so the NEXT close (even)
+    # spills level 0 and stages background merges on the worker pool
+    while app.herder.manual_close() % 2 == 0:
+        pass
+    app.config.ARTIFICIALLY_SLEEP_IN_CLOSE_FOR_TESTING = 0.4
+    slow_seq = app.herder.manual_close()
+    app.config.ARTIFICIALLY_SLEEP_IN_CLOSE_FOR_TESTING = 0.0
+    assert slow_seq % 2 == 0, "slow close must be a spill close"
+    traces = dict(app.tracer.slow_close_traces)
+    assert slow_seq in traces, "watchdog did not fire"
+    with open(traces[slow_seq], encoding="utf-8") as f:
+        trace = json.load(f)
+    rec = app.tracer.get_close(slow_seq)
+    assert rec is not None
+    return app, trace, rec
+
+
+def test_watchdog_persists_trace_with_root_span(slow_close):
+    _, trace, rec = slow_close
+    assert trace["metadata"]["ledger"] == rec.seq
+    names = {ev["name"] for ev in trace["traceEvents"]}
+    assert "ledger.close" in names
+    assert "ledger.close.test_delay" in names
+    # every event is a complete event with span identity in args
+    for ev in trace["traceEvents"]:
+        assert ev["ph"] == "X"
+        assert ev["args"]["span_id"]
+
+
+def test_slow_close_spans_cover_95_percent_of_wall_time(slow_close):
+    _, trace, rec = slow_close
+    events = trace["traceEvents"]
+    root = next(ev for ev in events
+                if ev["args"]["span_id"] == rec.root_id)
+    assert root["name"] == "ledger.close"
+    children_dur = sum(ev["dur"] for ev in events
+                      if ev["args"]["parent_id"] == rec.root_id)
+    assert children_dur >= 0.95 * root["dur"], (
+        f"direct children cover {children_dur / root['dur']:.1%} "
+        f"of the close")
+
+
+def test_bucket_worker_spans_parent_across_threads(slow_close):
+    _, trace, rec = slow_close
+    events = trace["traceEvents"]
+    by_id = {ev["args"]["span_id"]: ev for ev in events}
+    root = by_id[rec.root_id]
+    bg = [ev for ev in events
+          if ev["name"] == "bucket.merge.background"]
+    assert bg, "no worker-pool merge spans in the slow close's record"
+    cross = [ev for ev in bg if ev["tid"] != root["tid"]]
+    assert cross, "merge spans did not run on a worker thread"
+    for ev in cross:
+        # the parent chain must resolve WITHIN the record back to the
+        # close root: worker span -> ledger.close.bucket -> ledger.close
+        chain = [ev["name"]]
+        cur = ev
+        for _ in range(10):
+            pid = cur["args"]["parent_id"]
+            assert pid in by_id, f"dangling parent for chain {chain}"
+            cur = by_id[pid]
+            chain.append(cur["name"])
+            if cur["args"]["span_id"] == rec.root_id:
+                break
+        assert chain[-1] == "ledger.close", chain
+        assert "ledger.close.bucket" in chain, chain
+
+
+def test_watchdog_logs_one_line_summary(tmp_path, caplog):
+    import logging
+
+    app = make_app(SLOW_CLOSE_THRESHOLD_SECONDS=0.05,
+                   TRACE_DIR=str(tmp_path))
+    app.config.ARTIFICIALLY_SLEEP_IN_CLOSE_FOR_TESTING = 0.15
+    with caplog.at_level(logging.WARNING,
+                         logger="stellar_core_tpu.Perf"):
+        seq = app.herder.manual_close()
+    msgs = [r.getMessage() for r in caplog.records
+            if "slow close" in r.getMessage()]
+    assert any(f"ledger {seq}" in m and "trace persisted" in m
+               for m in msgs), msgs
+
+
+def test_trace_view_renders_persisted_trace(slow_close):
+    from tools.trace_view import render
+
+    _, trace, _ = slow_close
+    out = render(trace)
+    assert "ledger.close" in out
+    assert "top 10 spans by self time" in out
+    assert "bucket.merge.background" in out
+
+
+# ---------------------------------------------------------------------------
+# admin API surface
+# ---------------------------------------------------------------------------
+
+def test_trace_endpoint_serves_chrome_json(slow_close):
+    app, _, rec = slow_close
+    handler = CommandHandler(app)
+    status, body = handler.handle("/trace", {"ledger": str(rec.seq)})
+    assert status == 200
+    assert isinstance(body, RawBody)
+    assert body.content_type == "application/json"
+    doc = json.loads(body.data)
+    assert doc["metadata"]["ledger"] == rec.seq
+    assert doc["traceEvents"]
+    # latest close when ledger omitted; 404 with the retained list for
+    # an evicted one
+    status, body = handler.handle("/trace", {})
+    assert status == 200
+    status, body = handler.handle("/trace", {"ledger": "999999"})
+    assert status == 404
+    assert "retained_closes" in body
+
+
+def test_trace_summary_endpoint(slow_close):
+    app, _, rec = slow_close
+    handler = CommandHandler(app)
+    status, body = handler.handle("/trace/summary", {"k": "5"})
+    assert status == 200
+    assert rec.seq in body["closes_retained"]
+    tops = body["top_spans_by_self_time"]
+    assert tops and len(tops) <= 5
+    assert {"name", "self_ms", "count"} <= set(tops[0])
+    # the deliberate delay dominates self time across the ring
+    assert tops[0]["name"] == "ledger.close.test_delay"
+    assert any(t["ledger"] == rec.seq
+               for t in body["slow_close_traces"])
+
+
+_PROM_LINE = re.compile(
+    r"^(# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*(?: .*)?"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^}]*\})? [-+0-9.eEinfa]+)$")
+
+
+def test_metrics_prometheus_exposition(slow_close):
+    app, _, _ = slow_close
+    handler = CommandHandler(app)
+    status, body = handler.handle("/metrics", {"format": "prometheus"})
+    assert status == 200
+    assert isinstance(body, RawBody)
+    assert body.content_type.startswith("text/plain")
+    text = body.data.decode()
+    lines = [ln for ln in text.splitlines() if ln]
+    assert lines
+    for ln in lines:
+        assert _PROM_LINE.match(ln), f"bad exposition line: {ln!r}"
+    # span-derived timers (fed per close by the flight recorder) are in
+    # the scrape
+    assert "span_ledger_close_seconds" in text
+    assert "span_ledger_close_apply_seconds" in text
+
+
+def test_metrics_default_json_is_unchanged(slow_close):
+    """The JSON format must stay byte-identical for existing consumers:
+    same metric rendering per type, same top-level shape, no
+    prometheus-related keys leaking in."""
+    app, _, _ = slow_close
+    handler = CommandHandler(app)
+    status, body = handler.handle("/metrics", {})
+    assert status == 200
+    assert not isinstance(body, RawBody)
+    snap = body["metrics"]
+    # the pre-existing rendering contract, per metric type
+    c = snap["ledger.ledger.count"]
+    assert c == {"type": "counter", "count": c["count"]}
+    t = snap["ledger.ledger.close"]
+    assert set(t) == {"type", "count", "min", "max", "mean", "p50",
+                      "p75", "p99", "rate1m"}
+    assert t["type"] == "timer"
+    # the ad-hoc analysis blocks are still present and JSON-typed
+    for key in ("ledger.close.phases", "bucket.merge.pipeline",
+                "bucket.read.path", "ledger.prefetch.hit-rate"):
+        assert key in snap
+    json.dumps(body)  # whole body remains JSON-serializable
+
+
+# ---------------------------------------------------------------------------
+# ring-buffer bounds + disabled cost
+# ---------------------------------------------------------------------------
+
+def test_pending_ring_bounded_under_concurrent_writers():
+    tr = Tracer(enabled=True, max_pending=512)
+    stop = threading.Event()
+
+    def writer(i):
+        k = 0
+        while not stop.is_set() and k < 2000:
+            with tr.span(f"w{i}.spin", k=k):
+                pass
+            k += 1
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    stop.set()
+    assert tr.pending_count() <= 512
+    # a commit drains the bounded pending set into one close record
+    with tr.span("ledger.close") as root:
+        pass
+    rec = tr.commit_close(42, root)
+    assert rec is not None
+    assert len(rec.spans) <= 512 + 1
+    assert tr.pending_count() == 0
+
+
+def test_close_ring_evicts_oldest_closes():
+    tr = Tracer(enabled=True, ring_closes=3)
+    for seq in range(10, 16):
+        with tr.span("ledger.close", ledger=seq) as root:
+            pass
+        tr.commit_close(seq, root)
+    assert [r.seq for r in tr.closes()] == [13, 14, 15]
+    assert tr.get_close(10) is None
+    assert tr.get_close(14).seq == 14
+    assert tr.get_close().seq == 15
+
+
+def test_disabled_tracer_records_nothing_but_still_measures():
+    tr = Tracer(enabled=False)
+    with tr.span("x") as sp:
+        sum(range(1000))
+    assert sp.seconds > 0
+    assert tr.pending_count() == 0
+    assert tr.commit_close(1, sp) is None
+    assert tr.current_id() is None
+
+
+def test_disabled_close_still_produces_phase_breakdown():
+    app = make_app(TRACING_ENABLED=False)
+    app.herder.manual_close()
+    phases = app.ledger_manager.last_close_phases
+    assert phases["total"] > 0
+    for key in ("verify", "fee", "apply", "bucket", "commit", "gc"):
+        assert key in phases
+    assert app.tracer.closes() == []
+
+
+def test_cross_thread_parenting_via_explicit_token():
+    tr = Tracer(enabled=True)
+    seen = {}
+
+    def worker(token):
+        with tr.span("child.bg", parent=token) as sp:
+            pass
+        seen["span"] = sp
+
+    with tr.span("root") as root:
+        t = threading.Thread(target=worker, args=(tr.current_id(),))
+        t.start()
+        t.join()
+    assert seen["span"].parent_id == root.span_id
+    assert seen["span"].tid != root.tid
+
+
+# ---------------------------------------------------------------------------
+# analysis helpers
+# ---------------------------------------------------------------------------
+
+def test_self_time_summary_subtracts_children():
+    tr = Tracer(enabled=True)
+    with tr.span("outer") as outer:
+        with tr.span("inner"):
+            sum(range(20000))
+    rec = tr.commit_close(1, outer)
+    tops = summarize_ring([rec], k=2)
+    by_name = {t["name"]: t for t in tops}
+    assert by_name["inner"]["self_ms"] > by_name["outer"]["self_ms"]
+    doc = chrome_trace(rec)
+    assert len(doc["traceEvents"]) == 2

@@ -9,6 +9,12 @@ det-wallclock     time.*/datetime.now/random.*/uuid.*/os.environ reads in
                   transactions/xdr/crypto).  The virtual clock
                   (app.clock / VirtualClock) is the sanctioned time
                   source; seeded random.Random(seed) instances are fine.
+                  SANCTIONED instrumentation APIs — utils.tracing.span /
+                  utils.tracing.stopwatch / Timer.time_scope — are
+                  explicitly exempt: their perf_counter reads live in
+                  utils/ (outside the consensus scan) and feed only
+                  observability, so adding a span to a consensus module
+                  never needs a new baseline entry.
 det-unsorted-iter a for-loop / list-comp / generator over an unsorted
                   dict view (.items()/.values()/.keys()) or a set-typed
                   name, in a function that feeds a hash/serialize/tally
@@ -45,6 +51,26 @@ _WALLCLOCK_MODS: Dict[str, Set[str]] = {
     "os": {"getenv", "environ"},
 }
 _DATETIME_METHODS = {"now", "utcnow", "today"}
+
+# sanctioned instrumentation APIs: calls through these never produce
+# det-wallclock findings, whatever future rule tightening adds to the
+# banned table — instrumentation must stay cheap to add (the flight
+# recorder's whole point).  Matching is on the resolved call target:
+# "...utils.tracing.span", bare "span"/"stopwatch" from-imported from
+# the tracing module, and any ".time_scope" metric-timer scope.
+_SANCTIONED_SUFFIXES = (
+    "utils.tracing.span", "utils.tracing.stopwatch",
+    "tracing.span", "tracing.stopwatch",
+)
+_SANCTIONED_ATTRS = {"time_scope"}
+
+
+def is_sanctioned_timing_call(target: Optional[str]) -> bool:
+    if not target:
+        return False
+    if target.endswith(_SANCTIONED_SUFFIXES):
+        return True
+    return target.rpartition(".")[2] in _SANCTIONED_ATTRS
 
 # call names whose enclosing function marks iteration order as
 # consensus-visible: hashing/serialization, federated tallies, and
@@ -104,6 +130,8 @@ class _WallclockVisitor(ContextVisitor):
     def _check_target(self, node: ast.AST, target: Optional[str]) -> None:
         if not target or "." not in target:
             # from-import resolution maps bare names to module.member
+            return
+        if is_sanctioned_timing_call(target):
             return
         mod, _, attr = target.rpartition(".")
         # datetime.datetime.now / date.today

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""ASCII flame summary for persisted flight-recorder traces.
+
+The slow-close watchdog (stellar_core_tpu/utils/tracing.py) persists
+Chrome ``trace_event`` JSON; chrome://tracing / Perfetto render it, but
+the container has no browser.  This renders the same file as an
+indented tree with proportional bars plus a top-self-time table.
+
+Usage: python tools/trace_view.py <trace.json> [--width N] [--top K]
+"""
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+
+class Node:
+    __slots__ = ("name", "ts", "dur", "tid", "args", "children")
+
+    def __init__(self, ev: dict):
+        self.name = ev.get("name", "?")
+        self.ts = float(ev.get("ts", 0.0))        # µs
+        self.dur = float(ev.get("dur", 0.0))      # µs
+        self.tid = ev.get("tid", 0)
+        self.args = ev.get("args", {})
+        self.children: List["Node"] = []
+
+
+def build_forest(events: List[dict]) -> List[Node]:
+    """Parent by explicit span ids (the recorder exports them in args);
+    events without a resolvable parent become roots."""
+    nodes: Dict[int, Node] = {}
+    order: List[Node] = []
+    for ev in events:
+        n = Node(ev)
+        sid = ev.get("args", {}).get("span_id")
+        if sid is not None:
+            nodes[sid] = n
+        order.append(n)
+    roots: List[Node] = []
+    for n in order:
+        pid = n.args.get("parent_id")
+        parent = nodes.get(pid) if pid is not None else None
+        if parent is None or parent is n:
+            roots.append(n)
+        else:
+            parent.children.append(n)
+    for n in order:
+        n.children.sort(key=lambda c: c.ts)
+    roots.sort(key=lambda c: c.ts)
+    return roots
+
+
+def render_tree(roots: List[Node], width: int) -> List[str]:
+    total = max((r.dur for r in roots), default=0.0) or 1.0
+    lines: List[str] = []
+
+    def walk(n: Node, depth: int, main_tid) -> None:
+        bar = "#" * max(1, int(round(n.dur / total * width))) \
+            if n.dur > 0 else ""
+        cross = "" if n.tid == main_tid else \
+            f"  [thread {n.args.get('thread', n.tid)}]"
+        pct = n.dur / total * 100.0
+        lines.append(f"{'  ' * depth}{n.name:<{44 - 2 * min(depth, 10)}}"
+                     f"{n.dur / 1000.0:10.3f}ms {pct:5.1f}% "
+                     f"{bar}{cross}")
+        for c in n.children:
+            walk(c, depth + 1, main_tid)
+
+    for r in roots:
+        walk(r, 0, r.tid)
+    return lines
+
+
+def self_time_table(events: List[dict], top: int) -> List[str]:
+    by_sid = {ev["args"]["span_id"]: ev
+              for ev in events if ev.get("args", {}).get("span_id")}
+    selfs = {sid: float(ev.get("dur", 0.0))
+             for sid, ev in by_sid.items()}
+    for ev in events:
+        pid = ev.get("args", {}).get("parent_id")
+        parent = by_sid.get(pid)
+        # same-thread children only: cross-thread children (the bucket
+        # worker merges) run concurrently with their parent
+        if parent is not None and parent.get("tid") == ev.get("tid"):
+            selfs[pid] -= float(ev.get("dur", 0.0))
+    by_name: Dict[str, List[float]] = {}
+    for ev in events:
+        sid = ev.get("args", {}).get("span_id")
+        if sid is None:
+            continue
+        slot = by_name.setdefault(ev.get("name", "?"), [0.0, 0])
+        slot[0] += selfs.get(sid, 0.0)
+        slot[1] += 1
+    lines = ["", f"top {top} spans by self time:",
+             f"  {'span':<36}{'self':>12}{'count':>8}"]
+    ranked = sorted(by_name.items(), key=lambda kv: (-kv[1][0], kv[0]))
+    for name, (self_us, count) in ranked[:top]:
+        lines.append(f"  {name:<36}{self_us / 1000.0:10.3f}ms"
+                     f"{count:8d}")
+    return lines
+
+
+def render(trace: dict, width: int = 40, top: int = 10) -> str:
+    events = trace.get("traceEvents", [])
+    meta = trace.get("metadata", {})
+    head = []
+    if meta:
+        head.append(f"ledger {meta.get('ledger', '?')}: "
+                    f"{meta.get('duration_ms', '?')}ms over "
+                    f"{len(events)} spans")
+        if meta.get("truncated_spans"):
+            head.append(f"  ({meta['truncated_spans']} oldest spans "
+                        "truncated from the ring)")
+    lines = head + render_tree(build_forest(events), width)
+    lines += self_time_table(events, top)
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="Chrome trace_event JSON file")
+    ap.add_argument("--width", type=int, default=40,
+                    help="flame bar width in columns")
+    ap.add_argument("--top", type=int, default=10,
+                    help="rows in the self-time table")
+    args = ap.parse_args()
+    try:
+        with open(args.trace, encoding="utf-8") as f:
+            trace = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"trace_view: cannot read {args.trace}: {e}",
+              file=sys.stderr)
+        return 2
+    print(render(trace, width=args.width, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

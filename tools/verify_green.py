@@ -13,7 +13,10 @@ errors are additionally grepped out of the log because
 `--continue-on-collection-errors` can leave a "green-looking" run that
 silently skipped whole files.
 
-Usage: python tools/verify_green.py        -> exit 0 iff green
+Usage: python tools/verify_green.py            -> exit 0 iff green
+       python tools/verify_green.py --timings  -> also print the 10
+           slowest tier-1 test FILES (aggregated from pytest's own
+           --durations accounting)
 """
 import os
 import re
@@ -41,7 +44,27 @@ def run_detlint() -> int:
     return proc.returncode
 
 
+def print_timings(log: str, top_n: int = 10) -> None:
+    """Aggregate pytest's --durations lines (``0.42s call path::test``)
+    per test FILE and print the slowest."""
+    totals = {}
+    for m in re.finditer(
+            r"^\s*([0-9.]+)s\s+(?:call|setup|teardown)\s+([^:\s]+)::",
+            log, re.M):
+        totals[m.group(2)] = totals.get(m.group(2), 0.0) + \
+            float(m.group(1))
+    if not totals:
+        print("verify_green: no duration lines found in the tier-1 log",
+              flush=True)
+        return
+    print(f"verify_green: {top_n} slowest test files:", flush=True)
+    width = max(len(f) for f in totals)
+    for f, s in sorted(totals.items(), key=lambda kv: -kv[1])[:top_n]:
+        print(f"  {f:<{width}}  {s:8.2f}s", flush=True)
+
+
 def main() -> int:
+    timings = "--timings" in sys.argv
     lint_rc = run_detlint()
     if lint_rc != 0:
         # distinct from test failures: the analyzer itself printed the
@@ -49,6 +72,10 @@ def main() -> int:
         print(f"verify_green: LINT RED (detlint --strict exited "
               f"{lint_rc})", flush=True)
     cmd = tier1_command()
+    if timings:
+        # same tier-1 line, plus pytest's own per-test durations (all of
+        # them: --durations=0) so the slow tail is attributable by file
+        cmd = cmd.replace("-m pytest", "-m pytest --durations=0 -vv", 1)
     print(f"verify_green: {cmd}", flush=True)
     proc = subprocess.run(["bash", "-c", cmd], cwd=REPO)
     rc = proc.returncode
@@ -73,6 +100,8 @@ def main() -> int:
         problems.append("ERRORS section in pytest output")
     m = re.search(r"\b(\d+) passed\b", tail)
     passed = m.group(1) if m else "?"
+    if timings:
+        print_timings(log)
     if lint_rc != 0:
         problems.append("unbaselined detlint findings (see LINT RED "
                         "above)")
