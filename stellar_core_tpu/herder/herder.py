@@ -424,8 +424,13 @@ class Herder:
             tx_set = TxSetFrame.make_from_transactions(
                 self.app.config.network_id(), lcl_hash, frames, lm.root,
                 max_tx_set_size or lcl_header.maxTxSetSize,
-                lcl_header.baseFee)
+                lcl_header.baseFee,
+                max_dex_ops=self.app.config.MAX_DEX_TX_OPERATIONS)
             self.pending_envelopes.add_tx_set(tx_set)
+            # plan the parallel apply of our own proposal NOW, off the
+            # close's critical path; the close consumes the cached plan
+            # when this exact set externalizes (apply/executor.py)
+            self.app.parallel_apply.preplan(tx_set, lm.root)
 
         close_time = max(
             int(self.app.clock.system_now()),

@@ -40,9 +40,13 @@ class TxSetFrame:
     def make_from_transactions(cls, network_id: bytes, lcl_hash: bytes,
                                frames: Sequence[TransactionFrame],
                                ltx_root, max_size: int,
-                               base_fee: int) -> "TxSetFrame":
+                               base_fee: int,
+                               max_dex_ops: Optional[int] = None
+                               ) -> "TxSetFrame":
         """Filter invalid txs, trim to max_size by fee rate (surge pricing),
-        keep per-account seq continuity (ref makeFromTransactions :234)."""
+        keep per-account seq continuity (ref makeFromTransactions :234).
+        ``max_dex_ops`` adds the DEX lane's per-lane op limit (config
+        MAX_DEX_TX_OPERATIONS; ref SurgePricingUtils.h lane config)."""
         # per-source continuity: keep the longest valid prefix per account
         by_source: Dict[bytes, List[TransactionFrame]] = {}
         for f in frames:
@@ -67,7 +71,8 @@ class TxSetFrame:
                     valid.append(f)
                     seq = f.seq_num()
             ltx.rollback()
-        valid = surge_pricing_filter(valid, max_size)
+        valid = surge_pricing_filter(valid, max_size,
+                                     max_dex_ops=max_dex_ops)
         return cls(network_id, lcl_hash, valid)
 
     @classmethod
@@ -301,14 +306,44 @@ class TxSetFrame:
         return verify
 
 
+#: op types riding the DEX lane (offers + path payments — everything
+#: that can cross the order book; ref TxSetUtils hasDexOperations)
+_DEX_OP_TYPES = frozenset((
+    T.OperationType.MANAGE_SELL_OFFER,
+    T.OperationType.MANAGE_BUY_OFFER,
+    T.OperationType.CREATE_PASSIVE_SELL_OFFER,
+    T.OperationType.PATH_PAYMENT_STRICT_RECEIVE,
+    T.OperationType.PATH_PAYMENT_STRICT_SEND,
+))
+
+
+def is_dex_tx(f: TransactionFrame) -> bool:
+    return any(opf.op.body.type in _DEX_OP_TYPES for opf in f.op_frames)
+
+
 def surge_pricing_filter(frames: List[TransactionFrame],
-                         max_ops: int) -> List[TransactionFrame]:
+                         max_ops: int,
+                         max_dex_ops: Optional[int] = None
+                         ) -> List[TransactionFrame]:
     """Trim to the ledger's op capacity by fee-per-op rate, highest first
     (ref applySurgePricing :1150 / SurgePricingUtils.h priority queue).
     Per-account seq chains are kept intact: dropping a tx drops its
-    successors."""
+    successors.
+
+    Lanes (ref SurgePricingUtils.h DexLimitingLaneConfig): every tx
+    counts against the generic ``max_ops`` capacity; txs containing DEX
+    ops ALSO count against the ``max_dex_ops`` lane when set, so order-
+    book traffic cannot crowd payments out of the whole ledger."""
     total_ops = sum(f.num_operations() for f in frames)
-    if total_ops <= max_ops:
+    # DEX classification scans every op of a frame — compute it once,
+    # not per prefix sum inside the trim loop (same O(n^2) shape the
+    # chain-position map below fixes)
+    dex = ({id(f): is_dex_tx(f) for f in frames}
+           if max_dex_ops is not None else {})
+    dex_total = (sum(f.num_operations() for f in frames if dex[id(f)])
+                 if max_dex_ops is not None else 0)
+    if total_ops <= max_ops and \
+            (max_dex_ops is None or dex_total <= max_dex_ops):
         return list(frames)
 
     def rate(f: TransactionFrame) -> Tuple:
@@ -321,28 +356,40 @@ def surge_pricing_filter(frames: List[TransactionFrame],
     by_source: Dict[bytes, List[TransactionFrame]] = {}
     for f in frames:
         by_source.setdefault(f.source_account_id(), []).append(f)
+    # chain position by identity, precomputed once — chain.index(f)
+    # inside the trim loop was O(n^2) on long same-source chains
+    chain_pos: Dict[int, int] = {}
     for _, fs in sorted(by_source.items()):
         fs.sort(key=lambda f: f.seq_num())
+        for pos, c in enumerate(fs):
+            chain_pos[id(c)] = pos
 
     kept: set = set()
     kept_order: List[TransactionFrame] = []
     ops = 0
+    dex_ops = 0
     dropped_sources = set()
     for f in sorted(frames, key=rate):
         src = f.source_account_id()
         if src in dropped_sources or id(f) in kept:
             continue
         chain = by_source[src]
-        pos = chain.index(f)
+        pos = chain_pos[id(f)]
         # a high-fee successor pulls its not-yet-kept (cheaper)
         # predecessors in with it — seq chains stay intact
         prefix = [c for c in chain[:pos + 1] if id(c) not in kept]
         prefix_ops = sum(c.num_operations() for c in prefix)
-        if ops + prefix_ops > max_ops:
+        prefix_dex = (sum(c.num_operations() for c in prefix
+                          if dex[id(c)])
+                      if max_dex_ops is not None else 0)
+        if ops + prefix_ops > max_ops or \
+                (max_dex_ops is not None
+                 and dex_ops + prefix_dex > max_dex_ops):
             dropped_sources.add(src)
             continue
         for c in prefix:
             kept.add(id(c))
             kept_order.append(c)
         ops += prefix_ops
+        dex_ops += prefix_dex
     return kept_order

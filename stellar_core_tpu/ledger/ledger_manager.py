@@ -211,18 +211,49 @@ class LedgerManager:
             # with per-operation-type cost attribution: frame.apply's op
             # loop feeds the collector, and the totals become synthetic
             # sub-spans of the apply span (payment vs. DEX crossing —
-            # the attribution gap of ROADMAP item 7)
+            # the attribution gap of ROADMAP item 7).
+            #
+            # Parallel path (apply/): plan conflict clusters over the
+            # canonical order, run them concurrently against footprint-
+            # guarded snapshots, merge the disjoint deltas back — bit-
+            # identical to the sequential loop, which stays as the
+            # always-correct fallback (planner declined / escape abort /
+            # PARALLEL_APPLY=0).
+            par = self.app.parallel_apply
+            plan = None
+            planned = False
+            if par.enabled and len(apply_order) >= 2:
+                with tracer.span("ledger.close.plan") as sp:
+                    plan = par.plan(tx_set, apply_order, ltx)
+                planned = True
+                self._phase(phases, "plan", sp.seconds)
             tx_result_metas: List[object] = []
             result_pairs: List[object] = []
+            encoded_rows: Optional[List[Tuple[bytes, bytes, bytes]]] = None
             with tracer.span("ledger.close.apply") as sp_apply, \
                     self.metrics.timer(
                         "ledger.transaction.apply").time_scope(), \
                     tracing.collect_op_costs() as op_costs:
+                outcome = None
+                if plan is not None:
+                    outcome = par.execute(
+                        plan, ltx, apply_order, verify,
+                        self.app.invariants.check_on_tx_apply)
+                if outcome is not None:
+                    encoded_rows = []
+                else:
+                    if par.enabled:
+                        par.stats["sequential_closes"] += 1
                 for i, frame in enumerate(apply_order):
-                    ok, result, meta = frame.apply(
-                        ltx, verify=verify,
-                        invariant_check=self.app.invariants
-                        .check_on_tx_apply)
+                    if outcome is not None:
+                        _ok, result, meta, meta_b, pair_b, env_b = \
+                            outcome[i]
+                        encoded_rows.append((env_b, pair_b, meta_b))
+                    else:
+                        _ok, result, meta = frame.apply(
+                            ltx, verify=verify,
+                            invariant_check=self.app.invariants
+                            .check_on_tx_apply)
                     pair = frame.result_pair(result)
                     result_pairs.append(pair)
                     tx_result_metas.append(T.TransactionResultMeta.make(
@@ -230,6 +261,11 @@ class LedgerManager:
                         feeProcessing=fee_changes[i],
                         txApplyProcessing=meta))
             self._phase(phases, "apply", sp_apply.seconds)
+            if planned and par.last_plan_stats:
+                phases["parallel"] = dict(
+                    par.last_plan_stats,
+                    mode=("parallel" if encoded_rows is not None
+                          else "sequential"))
             op_ms: dict = {}
             cursor = sp_apply.t0
             for name in sorted(op_costs.costs):
@@ -267,10 +303,19 @@ class LedgerManager:
 
             # phase 4: seal the header
             with tracer.span("ledger.close.hash") as sp:
-                result_set = T.TransactionResultSet.make(
-                    results=result_pairs)
-                tx_result_hash = xdr_sha256(T.TransactionResultSet,
-                                            result_set)
+                if encoded_rows is not None:
+                    # assemble the TransactionResultSet encoding from
+                    # the workers' pre-encoded TransactionResultPair
+                    # bytes (XDR VarArray = >I count + elements) —
+                    # byte-identical to encoding the whole set here
+                    tx_result_hash = sha256(
+                        len(result_pairs).to_bytes(4, "big")
+                        + b"".join(pb for _, pb, _ in encoded_rows))
+                else:
+                    result_set = T.TransactionResultSet.make(
+                        results=result_pairs)
+                    tx_result_hash = xdr_sha256(T.TransactionResultSet,
+                                                result_set)
                 sealed = ltx.header()._replace(
                     txSetResultHash=tx_result_hash,
                 )
@@ -305,7 +350,7 @@ class LedgerManager:
 
                 # phase 6: persist tx history rows (SQL, same commit)
                 self._store_tx_history(close_data.ledger_seq, apply_order,
-                                       tx_result_metas)
+                                       tx_result_metas, encoded_rows)
                 ltx.commit()
 
         with tracer.span("ledger.close.commit") as sp:
@@ -463,13 +508,23 @@ class LedgerManager:
             sl[0] = header.bucketListHash
         return header._replace(skipList=sl)
 
-    def _store_tx_history(self, seq: int, frames, metas) -> None:
+    def _store_tx_history(self, seq: int, frames, metas,
+                          encoded_rows=None) -> None:
+        """``encoded_rows`` — (envelope, result-pair, meta) bytes the
+        parallel executor pre-encoded on worker threads (overlapping the
+        GIL-free native serialization with other clusters' apply); when
+        absent, encode here like the reference."""
         cur = self.app.database.cursor()
+        if encoded_rows is not None:
+            rows = [(frame.full_hash(), seq, i, env_b, pair_b, meta_b)
+                    for i, (frame, (env_b, pair_b, meta_b))
+                    in enumerate(zip(frames, encoded_rows))]
+        else:
+            rows = [(frame.full_hash(), seq, i,
+                     T.TransactionEnvelope.encode(frame.envelope),
+                     T.TransactionResultPair.encode(meta.result),
+                     T.TransactionMeta.encode(meta.txApplyProcessing))
+                    for i, (frame, meta) in enumerate(zip(frames, metas))]
         cur.executemany(
             "INSERT INTO txhistory(txid, ledgerseq, txindex, txbody, "
-            "txresult, txmeta) VALUES(?,?,?,?,?,?)",
-            [(frame.full_hash(), seq, i,
-              T.TransactionEnvelope.encode(frame.envelope),
-              T.TransactionResultPair.encode(meta.result),
-              T.TransactionMeta.encode(meta.txApplyProcessing))
-             for i, (frame, meta) in enumerate(zip(frames, metas))])
+            "txresult, txmeta) VALUES(?,?,?,?,?,?)", rows)

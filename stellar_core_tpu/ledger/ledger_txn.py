@@ -661,6 +661,16 @@ class LedgerTxnRoot(AbstractLedgerTxn):
             if kb.startswith(prefix):
                 yield kb, T.LedgerEntry.decode(blob)
 
+    def _offers_by_pair(self, selling: bytes, buying: bytes):
+        """Every resting offer of one book direction — the parallel-apply
+        planner's order-book materialization (plan-time, main thread)."""
+        for kb, blob in self.db.execute(
+                "SELECT o.key, e.entry FROM offers o "
+                "JOIN ledgerentries e ON e.key = o.key "
+                "WHERE o.selling = ? AND o.buying = ? "
+                "ORDER BY o.price, o.offerid", (selling, buying)):
+            yield kb, T.LedgerEntry.decode(blob)
+
     def _offers_by_seller(self, sellerid: bytes):
         for kb, blob in self.db.execute(
                 "SELECT o.key, e.entry FROM offers o "

@@ -60,6 +60,12 @@ class Application:
             self, bucket_dir=getattr(config, "BUCKET_DIR_PATH_REAL", None))
         self.invariants = InvariantManager(config.INVARIANT_CHECKS)
         self.ledger_manager = LedgerManager(self)
+        # parallel transaction apply: footprint planner + conflict
+        # clusters + bit-identical concurrent executor (apply/), with
+        # its own PR-1-style worker pool when enabled
+        from ..apply import ParallelApplyManager
+
+        self.parallel_apply = ParallelApplyManager(self)
         self.work_scheduler = WorkScheduler(clock)
         self.herder = Herder(self)
         self.overlay_manager = None   # wired by overlay.setup (optional)
@@ -267,6 +273,7 @@ class Application:
 
     def graceful_stop(self) -> None:
         self.process_manager.shutdown()
+        self.parallel_apply.shutdown()
         self.bucket_manager.shutdown()
         if self.overlay_manager is not None:
             self.overlay_manager.shutdown()

@@ -166,6 +166,35 @@ class Config:
         # trip (a mid-close gen2 cycle costs >1s at 1000-tx closes)
         self.DEFERRED_GC: bool = kw.get("DEFERRED_GC", True)
 
+        # parallel transaction apply (stellar_core_tpu/apply/): footprint
+        # planner + conflict-cluster scheduler + bit-identical concurrent
+        # executor.  PARALLEL_APPLY is the kill switch (env
+        # PARALLEL_APPLY=0 also disables); WORKERS <= 1 disables too.
+        # Env reads live HERE on purpose: main/ is outside detlint's
+        # consensus det-wallclock scope, and the env only gates WHETHER
+        # the parallel path runs — results are bit-identical either way.
+        import os as _os
+
+        self.PARALLEL_APPLY: bool = kw.get(
+            "PARALLEL_APPLY",
+            _os.environ.get("PARALLEL_APPLY", "1") != "0")
+        self.PARALLEL_APPLY_WORKERS: int = kw.get(
+            "PARALLEL_APPLY_WORKERS",
+            int(_os.environ.get("PARALLEL_APPLY_WORKERS", "2") or 0))
+        # one JSON line of session apply stats appended at shutdown —
+        # tools/verify_green.py's parallel smoke aggregates these to
+        # report aborts observed across the suite
+        self.PARALLEL_APPLY_STATS_FILE: Optional[str] = kw.get(
+            "PARALLEL_APPLY_STATS_FILE",
+            _os.environ.get("PARALLEL_APPLY_STATS_FILE"))
+
+        # surge-pricing DEX lane: ops from DEX transactions (offers +
+        # path payments) admitted per ledger, on top of the total
+        # maxTxSetSize cap (ref SurgePricingUtils.h lane config /
+        # MAX_DEX_TX_OPERATIONS).  None = no DEX lane limit.
+        self.MAX_DEX_TX_OPERATIONS: Optional[int] = kw.get(
+            "MAX_DEX_TX_OPERATIONS")
+
         # flight recorder (utils/tracing.py): hierarchical span tracing
         # over the close path.  Disabled tracing still measures the
         # per-phase close breakdown; it just records no spans.
@@ -226,6 +255,11 @@ class Config:
         if self.ARTIFICIALLY_SLEEP_IN_CLOSE_FOR_TESTING < 0:
             raise ConfigError(
                 "ARTIFICIALLY_SLEEP_IN_CLOSE_FOR_TESTING must be >= 0")
+        if self.PARALLEL_APPLY_WORKERS < 0:
+            raise ConfigError("PARALLEL_APPLY_WORKERS must be >= 0")
+        if self.MAX_DEX_TX_OPERATIONS is not None and \
+                self.MAX_DEX_TX_OPERATIONS < 0:
+            raise ConfigError("MAX_DEX_TX_OPERATIONS must be >= 0")
         if self.CRYPTO_BACKEND not in ("cpu", "tpu", "auto"):
             raise ConfigError(
                 f"unknown CRYPTO_BACKEND {self.CRYPTO_BACKEND!r}")
@@ -365,6 +399,8 @@ class Config:
 def test_config(n: int = 0, **kw) -> Config:
     """getTestConfig equivalent (ref src/test/TestUtils): standalone,
     manual close, in-memory DB, accelerated time."""
+    import os
+
     defaults = dict(
         NODE_SEED=sha256(b"test-node-%d" % n),
         RUN_STANDALONE=True,
@@ -388,6 +424,12 @@ def test_config(n: int = 0, **kw) -> Config:
         # device-path tests opt in explicitly
         CRYPTO_BACKEND="cpu",
         SCP_TALLY_BACKEND="host",
+        # parallel apply stays opt-in for suites: the default tier-1
+        # pass exercises the sequential path; tools/verify_green.py's
+        # parallel smoke re-runs the suite with PARALLEL_APPLY_WORKERS=2
+        # exported, which flips every test Application to parallel
+        PARALLEL_APPLY_WORKERS=int(
+            os.environ.get("PARALLEL_APPLY_WORKERS", "0") or 0),
     )
     defaults.update(kw)
     return Config(**defaults)

@@ -32,6 +32,9 @@ class LoadGenerator:
         self._seqs = {}
         self._rate_timer = None
         self._rate_state: Optional[dict] = None
+        # payment destination graph: "ring" (i pays i+1; one conflict
+        # component) or "pairs" (2j <-> 2j+1; disjoint account pairs)
+        self.payment_pattern = "ring"
 
     # -- deterministic account derivation -----------------------------------
 
@@ -111,18 +114,34 @@ class LoadGenerator:
                                  amount=amount)))
         return self._sign_tx(src, [op], fee)
 
+    def _payment_dest(self, accts: List[SecretKey], i: int) -> bytes:
+        """Destination for payment i: ``ring`` (each account pays its
+        successor — one fully-connected conflict component, the
+        parallel-apply worst case) or ``pairs`` (2j <-> 2j+1 — disjoint
+        account pairs, the independent-users shape real traffic
+        approximates and conflict clustering can spread)."""
+        k = len(accts)
+        if self.payment_pattern == "pairs":
+            j = i % k
+            p = j ^ 1
+            if p >= k:
+                p = j  # odd pool tail: self-payment, still pair-local
+            return accts[p].public_key().raw
+        return accts[(i + 1) % k].public_key().raw
+
     def generate_payments(self, n: int,
                           accounts: Optional[List[SecretKey]] = None
                           ) -> List:
-        """n one-op payments round-robin across the account pool (each
-        account pays its successor; sequence numbers tracked per source)."""
+        """n one-op payments round-robin across the account pool
+        (destination graph per ``payment_pattern``; sequence numbers
+        tracked per source)."""
         accts = accounts or self.accounts
         assert accts, "CREATE accounts first"
         out = []
         k = len(accts)
         for i in range(n):
             src = accts[i % k]
-            dest = accts[(i + 1) % k].public_key().raw
+            dest = self._payment_dest(accts, i)
             out.append(self.payment_envelope(src, dest, 1 + (i % 1000)))
         return out
 
@@ -236,7 +255,7 @@ class LoadGenerator:
                 out.append(self.offer_envelope(
                     src, 10 + i % 90, 100 + (i % 50), 100))
             else:
-                dest = accts[(i + 1) % k].public_key().raw
+                dest = self._payment_dest(accts, i)
                 out.append(self.payment_envelope(src, dest,
                                                  1 + (i % 1000)))
         return out

@@ -149,6 +149,17 @@ class OpCostCollector:
             slot[0] += seconds
             slot[1] += 1
 
+    def add_many(self, type_name: str, seconds: float, count: int) -> None:
+        """Fold a pre-aggregated (seconds, count) bucket in — the
+        parallel-apply executor merges per-cluster collectors into the
+        close's collector this way."""
+        slot = self.costs.get(type_name)
+        if slot is None:
+            self.costs[type_name] = [seconds, count]
+        else:
+            slot[0] += seconds
+            slot[1] += count
+
 
 def op_collector() -> Optional[OpCostCollector]:
     """The active collector for THIS thread (None almost always — the

@@ -1,0 +1,384 @@
+"""Parallel transaction apply (ISSUE 5 tentpole): bit-identity of the
+footprint-planned concurrent executor against sequential apply, the
+planner's clustering rules, and the speculation guard's escape-abort
+fallback.
+
+The property at stake is consensus-critical: for ANY tx set, parallel
+and sequential apply must produce byte-identical ledger header hash,
+bucket-list hash and tx meta — across worker counts AND Python hash
+seeds.  The adversarial case (a deliberately under-declared footprint)
+must abort to the sequential path and STILL match.
+"""
+import json
+import os
+import random
+import subprocess
+import sys
+
+import pytest
+
+from stellar_core_tpu.apply import footprint as fp_mod
+from stellar_core_tpu.apply.planner import plan_parallel_apply
+from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+from stellar_core_tpu.main import Application, test_config
+from stellar_core_tpu.main.http_server import CommandHandler
+from stellar_core_tpu.simulation.load_generator import LoadGenerator
+from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+from stellar_core_tpu.xdr import types as T
+
+from .txtest import NETWORK_ID, TestAccount, TestLedger
+
+
+def _mk_app(workers, **kw):
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), test_config(
+        TESTING_UPGRADE_MAX_TX_SET_SIZE=300,
+        PARALLEL_APPLY_WORKERS=workers, **kw))
+    app.start()
+    return app
+
+
+def _close_and_fingerprint(app, fps):
+    app.herder.manual_close()
+    meta = app._meta_stream[-1] if app._meta_stream else None
+    fps.append((
+        app.ledger_manager.last_closed_hash(),
+        app.bucket_manager.get_bucket_list_hash(),
+        T.LedgerCloseMeta.encode(meta) if meta is not None else b""))
+
+
+def _run_workload(workers, seed=7, n_closes=5, txs=80, pattern="pairs",
+                  **kw):
+    """Seeded randomized mixed/DEX/conflicting workload through the
+    full node close path; returns (fingerprints, apply stats)."""
+    app = _mk_app(workers, **kw)
+    lg = LoadGenerator(app)
+    lg.payment_pattern = pattern
+    lg.create_accounts(40)
+    lg.setup_dex()
+    rng = random.Random(seed)
+    fps = []
+    # random payments over the FULL account pool form one giant
+    # connected component (n edges >> n/2 nodes) and the planner
+    # rightly refuses; group the accounts so independent components
+    # exist — conflicts stay real WITHIN each group, and the DEX group
+    # exercises crossing + book materialization
+    groups = [lg.accounts[g:g + 5] for g in range(0, 40, 5)]
+
+    def reverse_offer(src, amount, pn, pd):
+        # sell LOAD for native — the opposite direction of loadgen's
+        # offer_envelope, so books CROSS and crossings settle against
+        # resting sellers (the book-materialization surface)
+        from stellar_core_tpu.transactions import utils as U
+
+        op = T.Operation.make(
+            sourceAccount=None,
+            body=T.OperationBody.make(
+                T.OperationType.MANAGE_SELL_OFFER,
+                T.ManageSellOfferOp.make(
+                    selling=lg.dex_asset, buying=U.asset_native(),
+                    amount=amount, price=T.Price.make(n=pn, d=pd),
+                    offerID=0)))
+        return lg._sign_tx(src, [op], 100)
+
+    for _ in range(n_closes):
+        envs = []
+        for i in range(txs):
+            grp = groups[rng.randrange(len(groups))]
+            src = grp[rng.randrange(len(grp))]
+            roll = rng.random()
+            if roll < 0.15:
+                envs.append(lg.offer_envelope(
+                    src, 5 + rng.randrange(50),
+                    90 + rng.randrange(20), 100))
+            elif roll < 0.30:
+                envs.append(reverse_offer(
+                    src, 5 + rng.randrange(50),
+                    90 + rng.randrange(20), 100))
+            else:
+                dest = grp[rng.randrange(len(grp))].public_key().raw
+                envs.append(lg.payment_envelope(
+                    src, dest, 1 + rng.randrange(500)))
+        admitted = sum(1 for env in envs
+                       if app.herder.recv_transaction(env) == 0)
+        assert admitted > 0
+        _close_and_fingerprint(app, fps)
+    stats = dict(app.parallel_apply.stats)
+    app.graceful_stop()
+    return fps, stats
+
+
+def _assert_identical(a, b, what):
+    assert len(a) == len(b)
+    for i, (x, y) in enumerate(zip(a, b)):
+        assert x[0] == y[0], f"{what}: ledger hash diverged at close {i}"
+        assert x[1] == y[1], f"{what}: bucket hash diverged at close {i}"
+        assert x[2] == y[2], f"{what}: tx meta diverged at close {i}"
+
+
+# -- the bit-identity property -----------------------------------------------
+
+def test_parallel_matches_sequential_across_worker_counts():
+    baseline, base_stats = _run_workload(0)
+    assert base_stats["parallel_closes"] == 0
+    for workers in (2, 4):
+        fps, stats = _run_workload(workers)
+        _assert_identical(baseline, fps, f"workers={workers}")
+        assert stats["parallel_closes"] > 0, \
+            f"workers={workers} never engaged the parallel path: {stats}"
+        assert stats["aborts"] == 0, stats
+
+
+def test_parallel_matches_sequential_more_seeds():
+    for seed in (11, 42):
+        seq, _ = _run_workload(0, seed=seed, n_closes=3)
+        par, stats = _run_workload(4, seed=seed, n_closes=3)
+        _assert_identical(seq, par, f"seed={seed}")
+        assert stats["parallel_closes"] > 0, stats
+
+
+def test_ring_pattern_conflicts_collapse_to_sequential():
+    """The ring payment graph is one conflict component: the planner
+    must refuse (single cluster), not parallelize wrongly."""
+    seq, _ = _run_workload(0, pattern="ring", n_closes=2)
+    par, stats = _run_workload(2, pattern="ring", n_closes=2)
+    _assert_identical(seq, par, "ring")
+
+
+def test_kill_switch_disables_parallel():
+    fps, stats = _run_workload(2, n_closes=2, PARALLEL_APPLY=False)
+    assert stats["parallel_closes"] == 0
+    seq, _ = _run_workload(0, n_closes=2)
+    _assert_identical(seq, fps, "kill switch")
+
+
+# -- PYTHONHASHSEED variation (subprocess) -----------------------------------
+
+_HASHSEED_WORKER = """
+import hashlib
+import sys
+
+sys.path.insert(0, {repo!r})
+from tests.test_apply_determinism import _run_mixed_workload
+
+for lh, bh, meta in _run_mixed_workload():
+    print(lh.hex(), bh.hex(), hashlib.sha256(meta).hexdigest())
+"""
+
+
+@pytest.mark.slow
+def test_parallel_close_bit_identical_under_hashseed_variation():
+    """The determinism guard's mixed workload, parallel apply ON, under
+    PYTHONHASHSEED 0 vs 4242 — per-close fingerprints must match."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    outputs = []
+    for seed in ("0", "4242"):
+        env = dict(os.environ)
+        env["PYTHONHASHSEED"] = seed
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PARALLEL_APPLY_WORKERS"] = "2"
+        proc = subprocess.run(
+            [sys.executable, "-c", _HASHSEED_WORKER.format(repo=repo)],
+            capture_output=True, text=True, cwd=repo, env=env,
+            timeout=600)
+        assert proc.returncode == 0, proc.stderr[-4000:]
+        lines = proc.stdout.strip().splitlines()
+        assert len(lines) >= 8, proc.stdout
+        outputs.append(lines)
+    a, b = outputs
+    assert a == b, "parallel close fingerprints diverged across hash seeds"
+
+
+# -- the speculation guard ---------------------------------------------------
+
+def _paylike_workload(workers):
+    """Deterministic pairs-pattern payment closes; returns
+    (fingerprints, stats, app) with the app still running."""
+    app = _mk_app(workers)
+    lg = LoadGenerator(app)
+    lg.payment_pattern = "pairs"
+    lg.create_accounts(40)
+    fps = []
+    for _ in range(3):
+        envs = lg.generate_payments(80)
+        assert sum(1 for env in envs
+                   if app.herder.recv_transaction(env) == 0) == 80
+        _close_and_fingerprint(app, fps)
+    return fps, dict(app.parallel_apply.stats), app
+
+
+def test_footprint_escape_aborts_to_sequential_and_matches():
+    """Adversarial case: payments under-declare their destination.  The
+    executor must catch the escape at runtime, abort the parallel
+    attempt, replay sequentially, and still produce the sequential
+    fingerprints — with the abort surfaced in metrics/Prometheus."""
+    baseline, _, base_app = _paylike_workload(0)
+    base_app.graceful_stop()
+
+    real_handler = fp_mod.OP_FOOTPRINTS[T.OperationType.PAYMENT]
+
+    def lying_payment_footprint(fp, opf, ctx):
+        pass  # declares NOTHING beyond source accounts
+
+    fp_mod.OP_FOOTPRINTS[T.OperationType.PAYMENT] = lying_payment_footprint
+    try:
+        fps, stats, app = _paylike_workload(2)
+        assert stats["aborts"] > 0, f"no abort despite lying footprints: " \
+            f"{stats}"
+        assert stats["escapes"], stats
+        assert "undeclared key access" in stats["escapes"][-1]
+        # surfaced in metrics + Prometheus exposition
+        assert app.metrics.counter("apply.parallel.abort").count \
+            == stats["aborts"]
+        handler = CommandHandler(app)
+        code, body = handler.handle("metrics", {"format": "prometheus"})
+        assert code == 200
+        text = body.data.decode()
+        assert "apply_parallel_abort" in text.replace(".", "_") or \
+            "apply.parallel.abort" in text
+        app.graceful_stop()
+    finally:
+        fp_mod.OP_FOOTPRINTS[T.OperationType.PAYMENT] = real_handler
+    _assert_identical(baseline, fps, "escape-abort")
+
+
+def test_cluster_spans_reach_the_trace_endpoint():
+    """A parallel close's ledger.apply.cluster spans (worker threads,
+    cross-thread parent tokens) must land in trace?ledger=N."""
+    app = _mk_app(2)
+    lg = LoadGenerator(app)
+    lg.payment_pattern = "pairs"
+    lg.create_accounts(20)
+    fps = []
+    envs = lg.generate_payments(40)
+    assert sum(1 for env in envs
+               if app.herder.recv_transaction(env) == 0) == 40
+    _close_and_fingerprint(app, fps)
+    assert app.parallel_apply.stats["parallel_closes"] == 1
+    seq = app.ledger_manager.last_closed_seq()
+    handler = CommandHandler(app)
+    code, body = handler.handle("trace", {"ledger": str(seq)})
+    assert code == 200
+    trace = json.loads(body.data.decode())
+    cluster_events = [e for e in trace["traceEvents"]
+                      if e["name"] == "ledger.apply.cluster"]
+    assert cluster_events, "no cluster spans in the close trace"
+    # cross-thread parenting: cluster spans parent into the apply span
+    by_id = {e["args"]["span_id"]: e for e in trace["traceEvents"]}
+    apply_ids = {e["args"]["span_id"] for e in trace["traceEvents"]
+                 if e["name"] == "ledger.close.apply"}
+    for ev in cluster_events:
+        assert ev["args"]["parent_id"] in apply_ids
+        parent = by_id[ev["args"]["parent_id"]]
+        assert parent["tid"] != ev["tid"], \
+            "cluster span should run on a worker thread"
+    app.graceful_stop()
+
+
+# -- planner unit tests ------------------------------------------------------
+
+def _frames(*envs):
+    from stellar_core_tpu.transactions.frame import tx_frame_from_envelope
+
+    return [tx_frame_from_envelope(NETWORK_ID, env) for env in envs]
+
+
+def _plan(ledger, frames):
+    with LedgerTxn(ledger.root_txn) as ltx:
+        plan, stats = plan_parallel_apply(frames, ltx)
+        ltx.rollback()
+    return plan, stats
+
+
+def test_planner_disjoint_payments_split():
+    lg = TestLedger()
+    root = lg.root()
+    a = root.create("pa", 10**9)
+    b = root.create("pb", 10**9)
+    c = root.create("pc", 10**9)
+    d = root.create("pd", 10**9)
+    plan, stats = _plan(lg, _frames(
+        a.tx([a.op_payment(b.account_id, 100)]),
+        c.tx([c.op_payment(d.account_id, 100)])))
+    assert plan is not None and stats["clusters"] == 2
+    assert stats["max_width"] == 1
+
+
+def test_planner_shared_destination_merges():
+    lg = TestLedger()
+    root = lg.root()
+    a = root.create("qa", 10**9)
+    b = root.create("qb", 10**9)
+    c = root.create("qc", 10**9)
+    plan, stats = _plan(lg, _frames(
+        a.tx([a.op_payment(c.account_id, 100)]),
+        b.tx([b.op_payment(c.account_id, 100)])))
+    assert plan is None and stats["unplanned"] == "single cluster"
+
+
+def _op_sell(acct, selling, buying, amount, pn=1, pd=1):
+    return acct.op(T.OperationType.MANAGE_SELL_OFFER,
+                   T.ManageSellOfferOp.make(
+                       selling=selling, buying=buying, amount=amount,
+                       price=T.Price.make(n=pn, d=pd), offerID=0))
+
+
+def test_planner_offer_creators_share_the_idpool_cluster():
+    from stellar_core_tpu.transactions import utils as U
+
+    lg = TestLedger()
+    root = lg.root()
+    a = root.create("ra", 10**9)
+    b = root.create("rb", 10**9)
+    c = root.create("rc", 10**9)
+    d = root.create("rd", 10**9)
+    # issuers never send txs here, so issuer READS don't merge clusters
+    iz1 = root.create("riz1", 10**9)
+    iz2 = root.create("riz2", 10**9)
+    usd = U.make_asset(b"USD", iz1.account_id)
+    eur = U.make_asset(b"EUR", iz2.account_id)
+    xlm = U.asset_native()
+    plan, stats = _plan(lg, _frames(
+        b.tx([b.op_change_trust(usd)]),
+        # two offers on DIFFERENT books still merge: both allocate from
+        # header.idPool, whose values are consensus-visible
+        a.tx([_op_sell(a, xlm, usd, 100)]),
+        c.tx([_op_sell(c, xlm, eur, 100)]),
+        d.tx([d.op_payment(root.account_id, 5)]),
+    ))
+    assert plan is not None, stats
+    widths = sorted(len(cl.indices) for cl in plan.clusters)
+    assert stats["clusters"] == 3, stats
+    assert widths == [1, 1, 2], (stats, widths)
+    # the two offer txs must share one cluster (idPool serialization)
+    offer_cluster = [cl for cl in plan.clusters
+                     if set(cl.indices) >= {1, 2}]
+    assert offer_cluster, [cl.indices for cl in plan.clusters]
+    # intra-cluster canonical order preserved
+    for cl in plan.clusters:
+        assert cl.indices == sorted(cl.indices)
+
+
+def test_planner_imprecise_op_declines():
+    lg = TestLedger()
+    root = lg.root()
+    a = root.create("sa", 10**9)
+    issuer = root.create("si", 10**9)
+    env = issuer.tx([issuer.op(
+        T.OperationType.ALLOW_TRUST,
+        T.AllowTrustOp.make(
+            trustor=T.account_id(a.account_id),
+            asset=T.AssetCode.make(T.AssetType.ASSET_TYPE_CREDIT_ALPHANUM4,
+                                   b"IMP\x00"),
+            authorize=1))])
+    plan, stats = _plan(lg, _frames(
+        env, a.tx([a.op_payment(root.account_id, 5)])))
+    assert plan is None
+    assert "allow_trust" in stats["unplanned"]
+
+
+def test_detlint_scope_covers_apply_package():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools.lint.engine import CONSENSUS_DIRS
+
+    assert "apply" in CONSENSUS_DIRS

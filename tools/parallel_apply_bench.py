@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Same-session sequential-vs-parallel apply A/B (ISSUE 5 acceptance):
+pay-heavy and mixed 1000-tx closes through the full node close path,
+alternating the parallel executor on/off per close so ledger-state
+drift (book growth, bucket spills) hits both arms equally.  Persists
+PARALLEL_APPLY_r09.json.
+
+The honest part: on CPython the GIL serializes the executor's Python
+work, so the A/B reports WHERE the time goes (plan cost and its
+nomination-time cache, the per-get speculation-guard tax inside
+frame.apply, the worker-side xdrpack encode relocation and what it
+saves in the hash/commit phases) rather than pretending a wall-clock
+win the interpreter cannot deliver.  Abort count on the standard
+workloads must be 0.
+
+Env knobs: BENCH_CLOSES (per arm, default 10), BENCH_CLOSE_TXS
+(default 1000), BENCH_DEX_PCT (default 30), BENCH_WORKERS (default 2).
+"""
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _note(msg):
+    print(f"[parallel-apply-bench] {msg}", file=sys.stderr, flush=True)
+
+
+def bench_workload(shape: str, pattern: str, n_closes: int,
+                   close_txs: int, dex_pct: int, workers: int) -> dict:
+    from stellar_core_tpu.main import Application, test_config
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+    from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), test_config(
+        UPGRADE_DESIRED_MAX_TX_SET_SIZE=max(100, close_txs),
+        DEFERRED_GC=True,
+        PARALLEL_APPLY_WORKERS=workers))
+    app.start()
+    app.herder.manual_close()  # applies the max-tx-set-size upgrade
+    lg = LoadGenerator(app)
+    lg.payment_pattern = pattern
+    lg.create_accounts(close_txs)
+    if shape == "mixed":
+        lg.setup_dex()
+    arms = {"sequential": [], "parallel": []}
+    phases = {"sequential": [], "parallel": []}
+    plan_rows = []
+    for i in range(2 * n_closes):
+        arm = "parallel" if i % 2 else "sequential"
+        app.parallel_apply.enabled = (arm == "parallel")
+        envs = (lg.generate_mixed(close_txs, dex_percent=dex_pct)
+                if shape == "mixed" else lg.generate_payments(close_txs))
+        admitted = sum(1 for env in envs
+                       if app.herder.recv_transaction(env) == 0)
+        assert admitted == close_txs, f"only {admitted} admitted"
+        t0 = time.perf_counter()
+        app.herder.manual_close()
+        arms[arm].append((time.perf_counter() - t0) * 1000.0)
+        phases[arm].append(dict(app.ledger_manager.last_close_phases))
+        if arm == "parallel":
+            plan_rows.append(dict(app.parallel_apply.last_plan_stats))
+    stats = {k: v for k, v in app.parallel_apply.stats.items()
+             if k != "escapes"}
+    stats["escape_reasons"] = app.parallel_apply.stats["escapes"][-4:]
+    app.graceful_stop()
+
+    def p50(xs):
+        return round(statistics.median(xs), 2) if xs else None
+
+    def phase_p50(arm, name):
+        vals = [row.get(name, 0.0) for row in phases[arm]
+                if isinstance(row.get(name, 0.0), (int, float))]
+        return round(statistics.median(vals), 2) if vals else None
+
+    seq_p50, par_p50 = p50(arms["sequential"]), p50(arms["parallel"])
+    row = {
+        "shape": shape,
+        "pattern": pattern,
+        "close_txs": close_txs,
+        "closes_per_arm": n_closes,
+        "workers": workers,
+        "seq_close_p50_ms": seq_p50,
+        "par_close_p50_ms": par_p50,
+        "par_vs_seq_pct": (round((par_p50 - seq_p50) / seq_p50 * 100.0, 1)
+                           if seq_p50 else None),
+        "seq_apply_p50_ms": phase_p50("sequential", "apply"),
+        "par_apply_p50_ms": phase_p50("parallel", "apply"),
+        "par_plan_p50_ms": phase_p50("parallel", "plan"),
+        "seq_hash_commit_p50_ms": round(
+            (phase_p50("sequential", "hash") or 0)
+            + (phase_p50("sequential", "commit") or 0), 2),
+        "par_hash_commit_p50_ms": round(
+            (phase_p50("parallel", "hash") or 0)
+            + (phase_p50("parallel", "commit") or 0), 2),
+        "apply_stats": stats,
+    }
+    if plan_rows:
+        def med(key):
+            vals = [r.get(key) for r in plan_rows
+                    if isinstance(r.get(key), (int, float))]
+            return round(statistics.median(vals), 2) if vals else None
+
+        row["plan"] = {
+            "clusters_p50": med("clusters"),
+            "max_width_p50": med("max_width"),
+            "conflict_rate_p50": med("conflict_rate"),
+            "native_encode_ms_p50": med("native_encode_ms"),
+            "preplanned": any(r.get("preplanned") for r in plan_rows),
+            "unplanned_reasons": sorted({
+                r["unplanned"] for r in plan_rows if "unplanned" in r}),
+        }
+    _note(f"{shape}/{pattern}: seq p50 {seq_p50}ms  par p50 {par_p50}ms "
+          f"({row['par_vs_seq_pct']}%)  aborts={stats['aborts']}")
+    return row
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    n_closes = int(os.environ.get("BENCH_CLOSES", "10"))
+    close_txs = int(os.environ.get("BENCH_CLOSE_TXS", "1000"))
+    dex_pct = int(os.environ.get("BENCH_DEX_PCT", "30"))
+    workers = int(os.environ.get("BENCH_WORKERS", "2"))
+
+    rows = [
+        bench_workload("pay", "pairs", n_closes, close_txs, dex_pct,
+                       workers),
+        bench_workload("mixed", "pairs", n_closes, close_txs, dex_pct,
+                       workers),
+        # the adversarial shape: one fully-connected payment ring — the
+        # planner must refuse it (single cluster) and the only cost is
+        # nomination-time planning
+        bench_workload("pay", "ring", max(3, n_closes // 2), close_txs,
+                       dex_pct, workers),
+    ]
+    total_aborts = sum(r["apply_stats"]["aborts"] for r in rows)
+    out = {
+        "metric": "parallel_apply_ab_r09",
+        "workloads": rows,
+        "aborts_total": total_aborts,
+        "honest_breakdown": {
+            "gil": "CPython's GIL serializes the executor's Python "
+                   "apply work, so concurrent clusters time-slice one "
+                   "interpreter; the measured parallel overhead is the "
+                   "speculation guard's per-access checks plus worker "
+                   "scheduling, NOT contention on ledger state "
+                   "(clusters are disjoint by construction).",
+            "plan_cost": "planning runs at nomination time and is "
+                         "cached by (tx-set hash, LCL hash) — "
+                         "preplan_hits in apply_stats shows the close "
+                         "path consuming cached plans (plan phase "
+                         "~0 ms).",
+            "native_overlap": "workers pre-encode TransactionMeta / "
+                              "TransactionResultPair / envelope bytes "
+                              "(native xdrpack) during apply; the "
+                              "hash phase then assembles the result-"
+                              "set hash from those bytes and the "
+                              "commit phase reuses them for tx-history "
+                              "rows — compare seq_hash_commit_p50_ms "
+                              "vs par_hash_commit_p50_ms.  xdrpack "
+                              "walks Python objects and cannot drop "
+                              "the GIL, so this is relocation+reuse, "
+                              "not overlap; a free-threaded build "
+                              "would turn the same seams into real "
+                              "concurrency.",
+            "bit_identity": "tests/test_parallel_apply.py holds the "
+                            "byte-identity property across worker "
+                            "counts and PYTHONHASHSEED values; the "
+                            "escape-abort fallback is exercised there "
+                            "too.",
+        },
+    }
+    path = os.path.join(REPO, "PARALLEL_APPLY_r09.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    _note(f"persisted {path}")
+    print(json.dumps({"metric": out["metric"],
+                      "aborts_total": total_aborts,
+                      "workloads": [
+                          {k: r[k] for k in ("shape", "pattern",
+                                             "seq_close_p50_ms",
+                                             "par_close_p50_ms",
+                                             "par_vs_seq_pct")}
+                          for r in rows]}))
+
+
+if __name__ == "__main__":
+    main()

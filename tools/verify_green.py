@@ -13,11 +13,21 @@ errors are additionally grepped out of the log because
 `--continue-on-collection-errors` can leave a "green-looking" run that
 silently skipped whole files.
 
+After the default pass, a PARALLEL-APPLY SMOKE re-runs the tier-1 line
+with ``PARALLEL_APPLY_WORKERS=2`` exported (flipping every test
+Application onto the apply/ planner+executor path) and reports the
+aborts observed across the suite (aggregated from the per-Application
+stats lines written via ``PARALLEL_APPLY_STATS_FILE``).  Bit-identity
+means the same suite must stay green either way.
+
 Usage: python tools/verify_green.py            -> exit 0 iff green
        python tools/verify_green.py --timings  -> also print the 10
            slowest tier-1 test FILES (aggregated from pytest's own
            --durations accounting)
+       --skip-parallel-smoke / --parallel-smoke-only control the second
+           pass.
 """
+import json
 import os
 import re
 import subprocess
@@ -63,8 +73,79 @@ def print_timings(log: str, top_n: int = 10) -> None:
         print(f"  {f:<{width}}  {s:8.2f}s", flush=True)
 
 
+def run_parallel_smoke(cmd: str) -> "tuple":
+    """The tier-1 line again with parallel apply forced on.  Returns
+    (problems, passed, abort_summary)."""
+    smoke_cmd = cmd.replace("/tmp/_t1.log", "/tmp/_t1p.log")
+    stats_path = "/tmp/_t1p_apply_stats.jsonl"
+    try:
+        os.unlink(stats_path)
+    except OSError:
+        pass
+    env = dict(os.environ)
+    env["PARALLEL_APPLY_WORKERS"] = "2"
+    env["PARALLEL_APPLY_STATS_FILE"] = stats_path
+    print(f"verify_green: [parallel smoke] PARALLEL_APPLY_WORKERS=2 "
+          f"{smoke_cmd}", flush=True)
+    proc = subprocess.run(["bash", "-c", smoke_cmd], cwd=REPO, env=env)
+    problems = []
+    if proc.returncode != 0:
+        problems.append(f"parallel smoke exited {proc.returncode}")
+    try:
+        with open("/tmp/_t1p.log", errors="replace") as f:
+            log = f.read()
+    except OSError:
+        problems.append("parallel smoke log missing")
+        log = ""
+    tail = "\n".join(log.splitlines()[-30:])
+    for pat, what in ((r"\b([1-9]\d*) failed\b", "failed tests"),
+                      (r"\b([1-9]\d*) errors?\b", "collection errors")):
+        m = re.search(pat, tail)
+        if m:
+            problems.append(f"parallel smoke: {m.group(1)} {what}")
+    m = re.search(r"\b(\d+) passed\b", tail)
+    passed = m.group(1) if m else "?"
+    totals = {"parallel_closes": 0, "sequential_closes": 0, "aborts": 0,
+              "unplanned": 0, "sessions": 0}
+    reasons = []
+    try:
+        with open(stats_path, errors="replace") as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                totals["sessions"] += 1
+                for k in ("parallel_closes", "sequential_closes",
+                          "aborts", "unplanned"):
+                    totals[k] += int(row.get(k, 0))
+                reasons.extend(row.get("escape_reasons", []))
+    except OSError:
+        pass
+    summary = (f"{totals['parallel_closes']} parallel closes, "
+               f"{totals['aborts']} aborts, "
+               f"{totals['unplanned']} unplanned, "
+               f"{totals['sessions']} app sessions")
+    if reasons:
+        summary += f"; escapes: {reasons[:4]}"
+    return problems, passed, summary
+
+
 def main() -> int:
     timings = "--timings" in sys.argv
+    smoke_only = "--parallel-smoke-only" in sys.argv
+    skip_smoke = "--skip-parallel-smoke" in sys.argv
+    if smoke_only:
+        cmd = tier1_command()
+        problems, passed, summary = run_parallel_smoke(cmd)
+        print(f"verify_green: parallel-apply smoke: {summary}", flush=True)
+        if problems:
+            print(f"verify_green: RED ({'; '.join(problems)}); "
+                  f"passed={passed}", flush=True)
+            return 1
+        print(f"verify_green: GREEN (parallel smoke passed={passed})",
+              flush=True)
+        return 0
     lint_rc = run_detlint()
     if lint_rc != 0:
         # distinct from test failures: the analyzer itself printed the
@@ -105,12 +186,19 @@ def main() -> int:
     if lint_rc != 0:
         problems.append("unbaselined detlint findings (see LINT RED "
                         "above)")
+    smoke_note = "parallel smoke skipped"
+    if not skip_smoke:
+        smoke_problems, smoke_passed, summary = run_parallel_smoke(cmd)
+        print(f"verify_green: parallel-apply smoke: {summary}",
+              flush=True)
+        problems.extend(smoke_problems)
+        smoke_note = f"parallel smoke passed={smoke_passed}"
     if problems:
         print(f"verify_green: RED ({'; '.join(problems)}); "
               f"passed={passed}", flush=True)
         return 1
-    print(f"verify_green: GREEN (passed={passed}, detlint clean)",
-          flush=True)
+    print(f"verify_green: GREEN (passed={passed}, detlint clean, "
+          f"{smoke_note})", flush=True)
     return 0
 
 
