@@ -175,7 +175,8 @@ class ClusterResult:
     """What one cluster task brings back to the main thread."""
 
     __slots__ = ("cluster_id", "records", "delta", "okeys", "vkeys",
-                 "header", "op_costs", "span_seconds", "encode_seconds")
+                 "header", "op_costs", "span_seconds", "encode_seconds",
+                 "native")
 
     def __init__(self, cluster_id: int):
         self.cluster_id = cluster_id
@@ -188,6 +189,10 @@ class ClusterResult:
         self.op_costs: Dict[str, List[float]] = {}
         self.span_seconds = 0.0
         self.encode_seconds = 0.0
+        # native-kernel outcome: "hit" (applied by the kernel),
+        # "decline:<reason>" (kernel refused, Python applied), or None
+        # (kernel never attempted)
+        self.native: Optional[str] = None
 
 
 class ParallelApplyManager:
@@ -198,10 +203,43 @@ class ParallelApplyManager:
         self.app = app
         cfg = app.config
         self.workers = int(getattr(cfg, "PARALLEL_APPLY_WORKERS", 0) or 0)
-        self.enabled = bool(getattr(cfg, "PARALLEL_APPLY", False)) and \
-            self.workers >= 2
+        parallel_on = bool(getattr(cfg, "PARALLEL_APPLY", False))
+        # NATIVE_APPLY=0 is the kernel kill switch: clusters then always
+        # run the Python reference apply.  NATIVE_APPLY_INLINE engages
+        # the planner+kernel WITHOUT a worker pool (workers 0/1): the
+        # kernel is faster even sequentially, and the single-cluster
+        # fast path needs no pool at all.
+        self.native_wanted = bool(getattr(cfg, "NATIVE_APPLY", True))
+        pool_on = parallel_on and self.workers >= 2
+        if self.native_wanted and (pool_on or parallel_on and
+                                   getattr(cfg, "NATIVE_APPLY_INLINE",
+                                           False)):
+            # probe (and build, once per process — the .so is cached)
+            # up front: a host whose kernel cannot build must not pay
+            # the single-cluster planning/snapshot overhead for
+            # guaranteed declines
+            from .native_apply import kernel_module
+
+            if kernel_module() is None:
+                self.native_wanted = False
+        inline_native = parallel_on and self.native_wanted and \
+            bool(getattr(cfg, "NATIVE_APPLY_INLINE", False))
+        self.enabled = pool_on or inline_native
+        if (self.enabled and self.native_wanted
+                and getattr(cfg, "INVARIANT_CHECKS", None)):
+            # surface the documented coverage tradeoff operationally:
+            # checkers run per-op on Python-applied clusters only, so an
+            # operator who configured them sees at startup that kernel
+            # clusters rely on the kernel's guards (NATIVE_APPLY=0 runs
+            # every checker on every tx; state bytes identical either way)
+            from ..utils.logging import get_logger
+
+            get_logger("Ledger").info(
+                "native apply kernel on: INVARIANT_CHECKS %s run on "
+                "Python-applied clusters only (NATIVE_APPLY=0 to check "
+                "every tx)", cfg.INVARIANT_CHECKS)
         self.executor = None
-        if self.enabled:
+        if pool_on:
             from concurrent.futures import ThreadPoolExecutor
 
             self.executor = ThreadPoolExecutor(
@@ -213,7 +251,11 @@ class ParallelApplyManager:
             "aborts": 0,
             "unplanned": 0,
             "preplan_hits": 0,
+            "native_hits": 0,      # clusters applied by the kernel
+            "native_declines": 0,  # kernel refused -> Python fallback
+            "native_off": 0,       # clusters never offered to the kernel
             "escapes": [],  # last few escape reasons, newest last
+            "native_decline_reasons": [],  # newest last, bounded
         }
         self.last_plan_stats: dict = {}
         # nomination-time plan cache: the plan is a pure function of
@@ -236,9 +278,13 @@ class ParallelApplyManager:
     def _append_stats_line(self, path: str) -> None:
         import json
 
-        line = {k: v for k, v in self.stats.items() if k != "escapes"}
+        line = {k: v for k, v in self.stats.items()
+                if k not in ("escapes", "native_decline_reasons")}
         line["escape_reasons"] = list(self.stats["escapes"])[-8:]
+        line["native_decline_reasons"] = \
+            list(self.stats["native_decline_reasons"])[-8:]
         line["workers"] = self.workers
+        line["native"] = self.native_wanted
         try:
             with open(path, "a", encoding="utf-8") as f:
                 f.write(json.dumps(line) + "\n")
@@ -260,7 +306,9 @@ class ParallelApplyManager:
         if len(apply_order) < 2:
             return
         with LedgerTxn(root) as ltx:
-            plan, stats = plan_parallel_apply(apply_order, ltx)
+            plan, stats = plan_parallel_apply(
+                apply_order, ltx,
+                allow_single_native=self.native_wanted)
             ltx.rollback()
         self._plan_cache[key] = (plan, stats)
         while len(self._plan_cache) > 4:
@@ -274,7 +322,9 @@ class ParallelApplyManager:
             self.stats["preplan_hits"] += 1
             stats = dict(stats, preplanned=True)
         else:
-            plan, stats = plan_parallel_apply(apply_order, ltx)
+            plan, stats = plan_parallel_apply(
+                apply_order, ltx,
+                allow_single_native=self.native_wanted)
         self.last_plan_stats = stats
         if plan is None:
             self.stats["unplanned"] += 1
@@ -296,20 +346,25 @@ class ParallelApplyManager:
         # pack clusters into a bounded number of tasks (round-robin by
         # cluster id — deterministic): a 1000-payment close can plan
         # hundreds of two-tx clusters, and one future per cluster would
-        # drown the win in submit/teardown overhead
-        n_tasks = min(len(plan.clusters), self.workers * 2)
-        groups: List[List] = [[] for _ in range(n_tasks)]
-        for cluster in plan.clusters:
-            groups[cluster.cluster_id % n_tasks].append(cluster)
-        futures = [self.executor.submit(
-            self._run_task, group, snapshot, apply_order, verify,
-            invariant_check, abort, tracer, parent_token)
-            for group in groups]
+        # drown the win in submit/teardown overhead.  A single-cluster
+        # plan (the kernel's adversarial-ring fast path) and the
+        # pool-less native-inline mode run on the close thread instead:
+        # one task's pool round-trip buys nothing.
+        inline = self.executor is None or len(plan.clusters) == 1
+        if inline:
+            groups: List[List] = [list(plan.clusters)]
+        else:
+            n_tasks = min(len(plan.clusters), self.workers * 2)
+            groups = [[] for _ in range(n_tasks)]
+            for cluster in plan.clusters:
+                groups[cluster.cluster_id % n_tasks].append(cluster)
         results: List[Optional[ClusterResult]] = []
         escape: Optional[str] = None
-        for fut in futures:
+
+        def _collect(run_group):
+            nonlocal escape
             try:
-                results.extend(fut.result())
+                results.extend(run_group())
             except FootprintEscape as e:
                 abort.set()
                 escape = escape or str(e)
@@ -320,6 +375,19 @@ class ParallelApplyManager:
                 abort.set()
                 escape = escape or f"worker exception: {e!r}"
                 results.append(None)
+
+        if inline:
+            for group in groups:
+                _collect(lambda g=group: self._run_task(
+                    g, snapshot, apply_order, verify, invariant_check,
+                    abort, tracer, parent_token))
+        else:
+            futures = [self.executor.submit(
+                self._run_task, group, snapshot, apply_order, verify,
+                invariant_check, abort, tracer, parent_token)
+                for group in groups]
+            for fut in futures:
+                _collect(fut.result)
         # a second header writer is a planner invariant violation —
         # detect it BEFORE any delta reaches the close LedgerTxn
         if sum(1 for r in results
@@ -373,6 +441,20 @@ class ParallelApplyManager:
                 records[idx] = rec
         self.stats["parallel_closes"] += 1
         metrics.counter("apply.parallel.close").inc()
+        # native-kernel accounting (main thread, after joins)
+        for res in results:
+            if res.native == "hit":
+                self.stats["native_hits"] += 1
+                metrics.counter("apply.native.hit").inc()
+            elif res.native is not None:
+                self.stats["native_declines"] += 1
+                metrics.counter("apply.native.decline").inc()
+                self.stats["native_decline_reasons"].append(
+                    res.native[len("decline:"):])
+                del self.stats["native_decline_reasons"][:-32]
+            else:
+                self.stats["native_off"] += 1
+                metrics.counter("apply.native.fallback").inc()
         encode_ms = sum(r.encode_seconds for r in results) * 1000.0
         self.last_plan_stats = dict(self.last_plan_stats,
                                     native_encode_ms=round(encode_ms, 3))
@@ -404,11 +486,40 @@ class ParallelApplyManager:
     def _run_cluster(self, cluster, snapshot,
                      apply_order, verify, invariant_check, abort,
                      tracer, parent_token) -> ClusterResult:
-        """Apply one cluster against its view, pre-encode
-        meta/result/envelope bytes, post-check the written keys."""
+        """Apply one cluster — native kernel first when eligible, the
+        Python reference apply otherwise (and on any kernel decline) —
+        pre-encoding meta/result/envelope bytes and post-checking the
+        written keys."""
         from ..utils import tracing
 
+        decline_reason = None
+        native_res = None
+        if self.native_wanted and cluster.kernel_ok:
+            from .native_apply import KernelDecline, run_cluster_native
+
+            with tracer.span("ledger.apply.cluster.native",
+                             parent=parent_token,
+                             cluster=cluster.cluster_id,
+                             txs=len(cluster.indices),
+                             outcome="hit") as nspan:
+                try:
+                    native_res = run_cluster_native(
+                        cluster, snapshot, apply_order, verify,
+                        ClusterResult)
+                except KernelDecline as e:
+                    decline_reason = str(e)
+                    if nspan.args is not None:
+                        nspan.args["outcome"] = "decline"
+                        nspan.args["reason"] = decline_reason
+            if native_res is not None:
+                native_res.op_costs = {"native_kernel": [
+                    nspan.seconds, len(cluster.indices)]}
+                native_res.span_seconds = nspan.seconds
+                return native_res
+
         res = ClusterResult(cluster.cluster_id)
+        if decline_reason is not None:
+            res.native = f"decline:{decline_reason}"
         view = ClusterView(snapshot, cluster, abort)
         with tracer.span("ledger.apply.cluster", parent=parent_token,
                          cluster=cluster.cluster_id,

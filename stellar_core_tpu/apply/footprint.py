@@ -43,7 +43,8 @@ class TxFootprint:
     """Declared footprint of one transaction frame."""
 
     __slots__ = ("index", "reads", "writes", "book_pairs",
-                 "allocates_offer_ids", "precise", "reason")
+                 "allocates_offer_ids", "precise", "reason",
+                 "kernel_shape")
 
     def __init__(self, index: int):
         self.index = index
@@ -54,6 +55,10 @@ class TxFootprint:
         self.allocates_offer_ids = False
         self.precise = True
         self.reason = ""
+        # native-apply eligibility: the structural (state-free) kernel
+        # shape of the frame, or None — a pure function of the tx, so
+        # nomination-time preplans carry it too (apply/native_apply.py)
+        self.kernel_shape: Optional[tuple] = None
 
     def all_keys(self) -> Set[bytes]:
         return self.reads | self.writes
@@ -435,7 +440,10 @@ OP_FOOTPRINTS = {
 
 def footprint_for(index: int, frame, ctx: PlanContext) -> TxFootprint:
     """Full declared footprint of one frame (fee-bump aware)."""
+    from .native_apply import frame_kernel_shape
+
     fp = TxFootprint(index)
+    fp.kernel_shape = frame_kernel_shape(frame)
     fp.writes.add(account_key_bytes(frame.source_account_id()))
     fee_src = getattr(frame, "fee_source_id", None)
     if fee_src is not None:

@@ -1,0 +1,236 @@
+"""Dispatch layer for the native GIL-free apply kernel.
+
+Bridges the cluster executor to ``native/apply_kernel.cpp``: decides
+per transaction whether its structure fits the kernel's op strip
+(``frame_kernel_shape``, consumed by the footprint pass so the planner
+can tag whole clusters), packs a kernel-eligible cluster's snapshot
+entries / order-book rows / tx descriptors into canonical XDR bytes,
+invokes the kernel (which releases the GIL for its whole run), and
+re-wraps the kernel's outputs — packed entry deltas, pre-encoded
+TransactionMeta / TransactionResult bytes — into the ``ClusterResult``
+shape the merge/hash/commit phases already consume.
+
+Parity contract: the kernel implements success paths only.  Any
+structural mismatch, unexpected entry state, failing check or
+arithmetic divergence comes back as a ``KernelDecline`` and the caller
+runs the unchanged Python reference apply for that cluster — identical
+bytes either way, which tests/test_native_apply.py holds across
+workloads, worker counts and hash seeds.
+
+Signature checking stays host-side ON PURPOSE: verdicts are already
+batch-verified (and cached) before the apply phase, so the dispatcher
+replays the master-key check the reference performs for a one-signer
+account — hint match + cached verdict — and declines anything richer
+(extra signers, non-master weights are state the kernel also guards).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..ledger.ledger_txn import _OFFER_PREFIX, account_key_bytes
+from ..ledger.packed import LazyUnion, PackedEntry
+from ..xdr import types as T
+
+OT = T.OperationType
+
+
+class KernelDecline(Exception):
+    """The kernel cannot apply this cluster; Python apply takes it."""
+
+
+def _screen_account(snapshot, account_id: bytes, idx: int) -> None:
+    """Pre-pack host screen for the account entries every kernel tx
+    MUST touch (tx source; payment destination).  The kernel's own
+    parse raises the same refusals, but only AFTER the cluster's whole
+    snapshot/book encode has been paid — and these shapes (extra
+    signers, an inflation destination) persist across closes, so
+    without the screen a cluster carrying such an account re-pays the
+    pack cost on every close just to hear the same "no".  The decoded
+    snapshot entry is already in hand: a few attribute reads decline
+    the cluster before any encoding.  The kernel's parse stays the
+    authority for every other shape."""
+    e = snapshot.store.get(account_key_bytes(account_id))
+    if e is not None:
+        acc = e.data.value
+        if acc.signers or acc.inflationDest is not None:
+            raise KernelDecline(
+                f"tx {idx}: unsupported account shape (host screen)")
+
+
+#: protocol constants the C kernel hardcodes (apply_kernel.cpp) paired
+#: with their Python source of truth — asserted before every dispatch
+#: so a constant drift disables the kernel instead of risking a fork
+def _constants_in_lockstep() -> bool:
+    from ..transactions import utils as U
+
+    return (U.MAX_OFFERS_TO_CROSS == 1000
+            and U.ACCOUNT_SUBENTRY_LIMIT == 1000
+            and U.INT64_MAX == 2**63 - 1
+            and int(T.AUTHORIZED_FLAG) == 1
+            and int(T.PASSIVE_FLAG) == 1)
+
+
+def kernel_module():
+    """The _applykernel extension, or None (build attempted once; the
+    native package serializes loading under its own lock)."""
+    from ..native import get_apply_kernel
+
+    return get_apply_kernel()
+
+
+def frame_kernel_shape(frame) -> Optional[tuple]:
+    """Structural (state-free) kernel eligibility of one frame; returns
+    a shape descriptor consumed by ``run_cluster_native`` or None.
+
+    Pure function of the transaction — safe to compute at plan time
+    (including nomination-time preplans) and cache on the footprint.
+    """
+    from ..transactions import utils as U
+    from ..transactions.frame import TransactionFrame
+
+    if type(frame) is not TransactionFrame:
+        return None  # fee bumps carry a second fee source
+    tx = frame.tx
+    if len(tx.operations) != 1:
+        return None
+    if tx.cond.type != T.PreconditionType.PRECOND_NONE:
+        return None  # time/ledger bounds + v2 preconditions stay host-side
+    if len(frame.signatures) != 1:
+        return None  # multisig evaluation stays host-side
+    op = tx.operations[0]
+    if op.sourceAccount is not None:
+        return None
+    body = op.body
+    if body.type == OT.PAYMENT:
+        b = body.value
+        if b.asset.type != T.AssetType.ASSET_TYPE_NATIVE:
+            return None  # credit payments keep the trustline reference path
+        return ("payment", U.muxed_to_account_id(b.destination), b.amount)
+    if body.type == OT.MANAGE_SELL_OFFER:
+        b = body.value
+        if b.offerID != 0 or b.amount <= 0:
+            return None  # modify/delete keep the reference path
+        return ("offer", T.Asset.encode(b.selling),
+                T.Asset.encode(b.buying), b.amount, b.price.n, b.price.d)
+    return None
+
+
+def _signature_ok(frame, verify) -> bool:
+    """The reference's master-key signature consume for a one-signer
+    envelope: hint match + (cached) ed25519 verdict."""
+    if verify is None:
+        from ..crypto import verify_sig as verify
+    ds = frame.signatures[0]
+    pub = frame.source_account_id()
+    return ds.hint == pub[-4:] and verify(pub, ds.signature,
+                                          frame.full_hash())
+
+
+def _tx_tuple(frame, shape) -> tuple:
+    if shape[0] == "payment":
+        return (int(OT.PAYMENT), frame.full_hash(),
+                frame.source_account_id(), frame.seq_num(), frame.tx.fee,
+                frame.fee_charged, shape[1], shape[2])
+    return (int(OT.MANAGE_SELL_OFFER), frame.full_hash(),
+            frame.source_account_id(), frame.seq_num(), frame.tx.fee,
+            frame.fee_charged, shape[1], shape[2], shape[3], shape[4],
+            shape[5])
+
+
+def run_cluster_native(cluster, snapshot, apply_order, verify,
+                       result_cls):
+    """Apply one kernel-eligible cluster natively.
+
+    Returns a populated ``result_cls`` (the executor's ClusterResult)
+    or raises ``KernelDecline`` — the caller then runs the Python
+    reference apply for the cluster.  Never mutates shared state: the
+    kernel works on copies, so a decline discards everything.
+    """
+    from ..utils import tracing
+
+    mod = kernel_module()
+    if mod is None:
+        raise KernelDecline("kernel unavailable")
+    if not _constants_in_lockstep():
+        raise KernelDecline("protocol constant drift")
+
+    header = snapshot.header
+    if header.ledgerVersion != 19:
+        # the kernel mirrors protocol-19 semantics; older gated
+        # behaviors (check order, liability rules) stay host-side
+        raise KernelDecline(
+            f"protocol version {header.ledgerVersion} not kernel-backed")
+    frames = [apply_order[i] for i in cluster.indices]
+    shapes = list(cluster.shapes)
+    for idx, frame, shape in zip(cluster.indices, frames, shapes):
+        if shape is None:
+            raise KernelDecline(f"tx {idx} not kernel-shaped")
+        if not _signature_ok(frame, verify):
+            # a failing signature is a FAILURE result, not a success —
+            # the reference path owns every non-success outcome
+            raise KernelDecline(f"tx {idx} signature not clean")
+        _screen_account(snapshot, frame.source_account_id(), idx)
+        if shape[0] == "payment":
+            _screen_account(snapshot, shape[1], idx)
+
+    params = (header.ledgerSeq, header.scpValue.closeTime, header.baseFee,
+              header.baseReserve, snapshot.idpool0)
+    entries = []
+    for kb in sorted(cluster.keys):
+        e = snapshot.store[kb]
+        entries.append((kb, None if e is None else T.LedgerEntry.encode(e)))
+    books = []
+    for pair in sorted(cluster.pairs):
+        directions = snapshot.books[pair]
+        for direction in sorted(directions):
+            books.append((direction[0], direction[1],
+                          [kb for _, _, kb in directions[direction]]))
+    txs = [_tx_tuple(frame, shape)
+           for frame, shape in zip(frames, shapes)]
+
+    out = mod.apply_cluster(params, entries, books, txs)
+    if not out[0]:
+        _, reason, tx_index = out
+        raise KernelDecline(f"kernel declined tx {tx_index}: {reason}")
+    _, deltas, records, idpool_final = out
+
+    from .executor import _is_fresh_offer_key
+
+    res = result_cls(cluster.cluster_id)
+    res.native = "hit"
+    declared = cluster.writes
+    for kb, eb in deltas:
+        # write-side guard, mirroring the executor's _post_check: every
+        # kernel write must be a declared write or a fresh offer id
+        if kb not in declared and not _is_fresh_offer_key(
+                kb, snapshot.idpool0):
+            raise KernelDecline(f"kernel wrote undeclared key {kb.hex()}")
+        res.delta[kb] = None if eb is None else PackedEntry(eb)
+        if kb.startswith(_OFFER_PREFIX):
+            res.okeys.add(kb)
+    if idpool_final != snapshot.idpool0:
+        if not cluster.writes_header:
+            raise KernelDecline("kernel allocated ids without the token")
+        res.header = header._replace(idPool=idpool_final)
+    inner_union = T.TransactionResult.fields[1][1]
+    ext_v0 = T.TransactionResult.fields[2][1].make(0)
+    with tracing.stopwatch() as sw:
+        for idx, frame, (meta_b, result_b) in zip(cluster.indices, frames,
+                                                  records):
+            pair_b = frame.full_hash() + result_b
+            env_b = T.TransactionEnvelope.encode(frame.envelope)
+            # TransactionResult is a struct: rebuild its cheap scalar
+            # fields eagerly (feeCharged i64 leads the encoding, ext v0
+            # trails) and keep only the result union lazy
+            result = T.TransactionResult.make(
+                feeCharged=frame.fee_charged,
+                result=LazyUnion(inner_union, result_b[8:-4]),
+                ext=ext_v0)
+            res.records[idx] = (
+                True,
+                result,
+                LazyUnion(T.TransactionMeta, meta_b),
+                meta_b, pair_b, env_b,
+            )
+    res.encode_seconds = sw.seconds
+    return res

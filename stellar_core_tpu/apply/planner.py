@@ -28,7 +28,7 @@ class Cluster:
     """One parallel unit: canonical-order tx indices + merged footprint."""
 
     __slots__ = ("cluster_id", "indices", "keys", "writes", "pairs",
-                 "writes_header")
+                 "writes_header", "kernel_ok", "shapes")
 
     def __init__(self, cluster_id: int):
         self.cluster_id = cluster_id
@@ -37,6 +37,13 @@ class Cluster:
         self.writes: Set[bytes] = set()
         self.pairs: Set[Tuple[bytes, bytes]] = set()
         self.writes_header = False
+        # every member tx is kernel-shaped: the executor may hand the
+        # whole cluster to the native apply kernel (state-level checks
+        # happen inside the kernel, which declines back to Python);
+        # ``shapes`` holds the per-tx kernel descriptors, parallel to
+        # ``indices`` (entries are None for non-eligible txs)
+        self.kernel_ok = True
+        self.shapes: List[Optional[tuple]] = []
 
 
 class ApplyPlan:
@@ -72,7 +79,7 @@ class _UnionFind:
                 self.parent[ra] = rb
 
 
-def plan_parallel_apply(apply_order, ltx
+def plan_parallel_apply(apply_order, ltx, allow_single_native: bool = False
                         ) -> Tuple[Optional[ApplyPlan], dict]:
     """Footprint every tx, build the conflict graph, emit clusters.
 
@@ -81,6 +88,12 @@ def plan_parallel_apply(apply_order, ltx
     Returns ``(plan, stats)``; ``plan`` is None (with no side effects)
     when the set has an imprecise footprint or collapses into a single
     cluster — ``stats["unplanned"]`` then says why.
+
+    ``allow_single_native``: emit a one-cluster plan anyway when that
+    cluster is kernel-eligible — the executor applies it INLINE through
+    the native kernel (the adversarial-ring case turns from planner
+    refusal into a native fast path; a kernel decline still lands on
+    the ordinary sequential loop).
     """
     n = len(apply_order)
     ctx = PlanContext(ltx)
@@ -161,6 +174,8 @@ def plan_parallel_apply(apply_order, ltx
         cluster.writes |= fp.writes
         cluster.pairs |= fp.book_pairs
         cluster.writes_header |= fp.allocates_offer_ids
+        cluster.kernel_ok &= fp.kernel_shape is not None
+        cluster.shapes.append(fp.kernel_shape)
     for cluster in clusters:
         for pair in cluster.pairs:
             mat = ctx.books[pair]
@@ -177,8 +192,12 @@ def plan_parallel_apply(apply_order, ltx
         "conflict_edges": conflict_edges,
         "conflict_rate": round(1.0 - len(clusters) / n, 4) if n else 0.0,
         "book_pairs": len(ctx.books),
+        "kernel_clusters": sum(1 for c in clusters if c.kernel_ok),
     }
     if len(clusters) < 2:
+        if allow_single_native and clusters and clusters[0].kernel_ok:
+            stats["single_native"] = True
+            return ApplyPlan(clusters, footprints, ctx, stats), stats
         stats["unplanned"] = "single cluster"
         return None, stats
     return ApplyPlan(clusters, footprints, ctx, stats), stats

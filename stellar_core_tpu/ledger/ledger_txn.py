@@ -19,6 +19,7 @@ from fractions import Fraction
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from ..xdr import types as T
+from .packed import entry_type_from_key
 
 
 class LedgerTxnError(Exception):
@@ -565,8 +566,12 @@ class LedgerTxnRoot(AbstractLedgerTxn):
                 cur.execute("DELETE FROM ledgerentries WHERE key = ?", (kb,))
                 cur.execute("DELETE FROM offers WHERE key = ?", (kb,))
             else:
+                # encode first: a PackedEntry from the native apply
+                # kernel serves its bytes via the LedgerEntry memo, and
+                # the entry type reads off the key's discriminant — the
+                # packed delta commits without decoding (ledger/packed)
                 eb = T.LedgerEntry.encode(entry)
-                et = entry.data.type
+                et = entry_type_from_key(kb)
                 cur.execute(
                     "INSERT INTO ledgerentries(key, type, entry) "
                     "VALUES(?,?,?) ON CONFLICT(key) DO UPDATE SET "

@@ -181,6 +181,24 @@ class Config:
         self.PARALLEL_APPLY_WORKERS: int = kw.get(
             "PARALLEL_APPLY_WORKERS",
             int(_os.environ.get("PARALLEL_APPLY_WORKERS", "2") or 0))
+        # native GIL-free apply kernel (native/apply_kernel.cpp) for
+        # kernel-eligible clusters; NATIVE_APPLY=0 is the kill switch —
+        # every cluster then runs the Python reference apply
+        # (bit-identical either way, enforced by test_native_apply.py).
+        # Note: INVARIANT_CHECKS run per-op on Python-applied clusters
+        # only; kernel-applied clusters rely on the kernel's own
+        # exact-shape parse + bounds guards (set NATIVE_APPLY=0 to run
+        # every configured checker on every tx).
+        self.NATIVE_APPLY: bool = kw.get(
+            "NATIVE_APPLY",
+            _os.environ.get("NATIVE_APPLY", "1") != "0")
+        # engage the planner+kernel WITHOUT a worker pool (workers 0/1):
+        # the kernel beats Python even applying clusters sequentially on
+        # the close thread.  Off by default so workers=0 keeps meaning
+        # "plain sequential apply" unless explicitly opted in.
+        self.NATIVE_APPLY_INLINE: bool = kw.get(
+            "NATIVE_APPLY_INLINE",
+            _os.environ.get("NATIVE_APPLY_INLINE", "0") == "1")
         # one JSON line of session apply stats appended at shutdown —
         # tools/verify_green.py's parallel smoke aggregates these to
         # report aborts observed across the suite

@@ -25,6 +25,38 @@ _lib: Optional[ctypes.CDLL] = None  # guarded-by: _lock
 _tried = False  # guarded-by: _lock
 
 
+def _src_digest(srcs) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    for s in srcs:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
+
+
+def _write_srchash(so: str, srcs) -> None:
+    tmp = f"{so}.srchash.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        f.write(_src_digest(srcs))
+    os.replace(tmp, so + ".srchash")
+
+
+def _stale(srcs, so: str) -> bool:
+    """Content-hash staleness: each built .so carries a ``.srchash``
+    sidecar recording its sources' digest.  mtimes are useless for the
+    prebuilt kernels shipped in the tree — git writes checkout files in
+    arbitrary order, so a source edit without a rebuild could win the
+    mtime race and load an outdated consensus kernel silently."""
+    if not os.path.exists(so):
+        return True
+    try:
+        with open(so + ".srchash") as f:
+            return f.read().strip() != _src_digest(srcs)
+    except OSError:
+        return True
+
+
 def _build() -> bool:
     try:
         r = subprocess.run(
@@ -33,6 +65,7 @@ def _build() -> bool:
         if r.returncode != 0:
             return False
         os.replace(_SO + ".tmp", _SO)
+        _write_srchash(_SO, _SRCS)
         return True
     except Exception:
         return False
@@ -46,8 +79,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO) or os.path.getmtime(_SO) < max(
-                os.path.getmtime(s) for s in _SRCS):
+        if _stale(_SRCS, _SO):
             if not _build():
                 return None
         try:
@@ -138,6 +170,82 @@ _xdrpack_mod = None  # guarded-by: _lock
 _xdrpack_tried = False  # guarded-by: _lock
 
 
+def _build_extension(src: str, so: str) -> bool:
+    """Compile one CPython extension source to ``so``; pid-unique tmp +
+    atomic replace so concurrent first-builds can never interleave into
+    one file and install a torn .so.  Shared by the xdrpack encoder and
+    the apply kernel."""
+    import sysconfig
+
+    inc = sysconfig.get_paths()["include"]
+    tmp = f"{so}.{os.getpid()}.tmp"
+    try:
+        r = subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-I", inc, "-o", tmp, src],
+            capture_output=True, timeout=180)
+        if r.returncode != 0:
+            return False
+        os.replace(tmp, so)
+        _write_srchash(so, [src])
+        return True
+    except Exception:
+        return False
+
+
+def _load_extension(name: str, so: str):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(name, so)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _ext_cached(name: str, src: str, so: str, mod, tried, build: bool):
+    """The one caching contract for the CPython-extension kernels
+    (caller holds ``_lock`` and passes/stores its module-level handle
+    pair): one-shot ``tried`` semantics, the ``build=False`` early
+    return that leaves a later ``build=True`` caller free to succeed,
+    and content-hash staleness.  Returns the updated ``(mod, tried)``
+    pair — keeping this logic in one place so a fix to the contract
+    cannot drift between the extensions."""
+    if mod is not None or tried:
+        return mod, tried
+    try:
+        if _stale([src], so):
+            if not build:
+                return None, False  # not tried: build=True may succeed
+            tried = True
+            if not _build_extension(src, so):
+                return None, True
+        else:
+            tried = True
+        mod = _load_extension(name, so)
+    except Exception:
+        return None, True
+    return mod, tried
+
+
+# -- native apply kernel (CPython extension; see apply_kernel.cpp) -------
+
+_APPLY_SRC = os.path.join(_DIR, "apply_kernel.cpp")
+_APPLY_SO = os.path.join(_DIR, "_applykernel.so")
+_applykernel_mod = None  # guarded-by: _lock
+_applykernel_tried = False  # guarded-by: _lock
+
+
+def get_apply_kernel(build: bool = True):
+    """The _applykernel extension (GIL-free transaction-apply kernel);
+    builds on first use, None when unavailable — callers fall back to
+    the Python reference apply."""
+    global _applykernel_mod, _applykernel_tried
+    with _lock:
+        _applykernel_mod, _applykernel_tried = _ext_cached(
+            "_applykernel", _APPLY_SRC, _APPLY_SO,
+            _applykernel_mod, _applykernel_tried, build)
+        return _applykernel_mod
+
+
 def get_xdrpack(build: bool = True):
     """The _xdrpack extension module (schema-driven XDR encoder); with
     ``build=False`` only an already-built fresh .so is loaded (imports
@@ -145,37 +253,7 @@ def get_xdrpack(build: bool = True):
     unavailable."""
     global _xdrpack_mod, _xdrpack_tried
     with _lock:
-        if _xdrpack_mod is not None or _xdrpack_tried:
-            return _xdrpack_mod
-        try:
-            import sysconfig
-
-            stale = (not os.path.exists(_XDRPACK_SO)
-                     or os.path.getmtime(_XDRPACK_SO)
-                     < os.path.getmtime(_XDRPACK_SRC))
-            if stale and not build:
-                return None  # not tried: a build=True caller may succeed
-            _xdrpack_tried = True
-            if stale:
-                inc = sysconfig.get_paths()["include"]
-                # pid-unique tmp: concurrent first-builds must not
-                # interleave into one file and install a torn .so
-                tmp = f"{_XDRPACK_SO}.{os.getpid()}.tmp"
-                r = subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-I", inc,
-                     "-o", tmp, _XDRPACK_SRC],
-                    capture_output=True, timeout=120)
-                if r.returncode != 0:
-                    return None
-                os.replace(tmp, _XDRPACK_SO)
-            import importlib.util
-
-            spec = importlib.util.spec_from_file_location(
-                "_xdrpack", _XDRPACK_SO)
-            mod = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(mod)
-            _xdrpack_mod = mod
-        except Exception:
-            _xdrpack_mod = None
-            _xdrpack_tried = True
+        _xdrpack_mod, _xdrpack_tried = _ext_cached(
+            "_xdrpack", _XDRPACK_SRC, _XDRPACK_SO,
+            _xdrpack_mod, _xdrpack_tried, build)
         return _xdrpack_mod

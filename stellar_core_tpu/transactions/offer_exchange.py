@@ -6,6 +6,16 @@ All the reference's uint128 intermediate math is exact Python int here —
 the bit-identical-results requirement (SURVEY.md §7 "hard parts") keeps
 this on host CPU, never on device.
 
+LOCKSTEP NOTE: ``native/apply_kernel.cpp`` mirrors this module's
+success-path arithmetic (exchangeV10 with/without thresholds,
+adjustOffer, offer liabilities, the crossing loop) in 64/128-bit C for
+the GIL-free apply kernel.  Behavioral changes here MUST be ported
+there; the kernel's protocol constants are asserted against this
+module's at dispatch time (apply/native_apply.py
+``_constants_in_lockstep``) and any divergence disables the kernel
+rather than risking a fork.
+tests/test_native_apply.py holds the bit-identity property.
+
 Terminology follows the reference: the book offer sells WHEAT and buys
 SHEEP at ``price`` = sheep-per-wheat (price.n/price.d); the taker sends
 sheep and receives wheat.

@@ -242,8 +242,10 @@ def test_footprint_escape_aborts_to_sequential_and_matches():
 
 
 def test_cluster_spans_reach_the_trace_endpoint():
-    """A parallel close's ledger.apply.cluster spans (worker threads,
-    cross-thread parent tokens) must land in trace?ledger=N."""
+    """A parallel close's per-cluster spans (worker threads,
+    cross-thread parent tokens) must land in trace?ledger=N —
+    ledger.apply.cluster for Python clusters, ledger.apply.cluster.native
+    for kernel-applied ones (payments are kernel-eligible)."""
     app = _mk_app(2)
     lg = LoadGenerator(app)
     lg.payment_pattern = "pairs"
@@ -260,7 +262,8 @@ def test_cluster_spans_reach_the_trace_endpoint():
     assert code == 200
     trace = json.loads(body.data.decode())
     cluster_events = [e for e in trace["traceEvents"]
-                      if e["name"] == "ledger.apply.cluster"]
+                      if e["name"] in ("ledger.apply.cluster",
+                                       "ledger.apply.cluster.native")]
     assert cluster_events, "no cluster spans in the close trace"
     # cross-thread parenting: cluster spans parent into the apply span
     by_id = {e["args"]["span_id"]: e for e in trace["traceEvents"]}

@@ -73,11 +73,16 @@ def print_timings(log: str, top_n: int = 10) -> None:
         print(f"  {f:<{width}}  {s:8.2f}s", flush=True)
 
 
-def run_parallel_smoke(cmd: str) -> "tuple":
-    """The tier-1 line again with parallel apply forced on.  Returns
+def run_parallel_smoke(cmd: str, native: bool = True) -> "tuple":
+    """The tier-1 line again with parallel apply forced on.  With
+    ``native=False`` the NATIVE_APPLY=0 kill switch is exported too —
+    the fallback-parity smoke: the Python reference apply must keep the
+    suite green on its own (kernel declines land there).  Returns
     (problems, passed, abort_summary)."""
-    smoke_cmd = cmd.replace("/tmp/_t1.log", "/tmp/_t1p.log")
-    stats_path = "/tmp/_t1p_apply_stats.jsonl"
+    tag = "parallel" if native else "fallback"
+    log_path = f"/tmp/_t1p_{tag}.log"
+    smoke_cmd = cmd.replace("/tmp/_t1.log", log_path)
+    stats_path = f"/tmp/_t1p_{tag}_apply_stats.jsonl"
     try:
         os.unlink(stats_path)
     except OSError:
@@ -85,28 +90,30 @@ def run_parallel_smoke(cmd: str) -> "tuple":
     env = dict(os.environ)
     env["PARALLEL_APPLY_WORKERS"] = "2"
     env["PARALLEL_APPLY_STATS_FILE"] = stats_path
-    print(f"verify_green: [parallel smoke] PARALLEL_APPLY_WORKERS=2 "
-          f"{smoke_cmd}", flush=True)
+    env["NATIVE_APPLY"] = "1" if native else "0"
+    print(f"verify_green: [{tag} smoke] PARALLEL_APPLY_WORKERS=2 "
+          f"NATIVE_APPLY={env['NATIVE_APPLY']} {smoke_cmd}", flush=True)
     proc = subprocess.run(["bash", "-c", smoke_cmd], cwd=REPO, env=env)
     problems = []
     if proc.returncode != 0:
-        problems.append(f"parallel smoke exited {proc.returncode}")
+        problems.append(f"{tag} smoke exited {proc.returncode}")
     try:
-        with open("/tmp/_t1p.log", errors="replace") as f:
+        with open(log_path, errors="replace") as f:
             log = f.read()
     except OSError:
-        problems.append("parallel smoke log missing")
+        problems.append(f"{tag} smoke log missing")
         log = ""
     tail = "\n".join(log.splitlines()[-30:])
     for pat, what in ((r"\b([1-9]\d*) failed\b", "failed tests"),
                       (r"\b([1-9]\d*) errors?\b", "collection errors")):
         m = re.search(pat, tail)
         if m:
-            problems.append(f"parallel smoke: {m.group(1)} {what}")
+            problems.append(f"{tag} smoke: {m.group(1)} {what}")
     m = re.search(r"\b(\d+) passed\b", tail)
     passed = m.group(1) if m else "?"
     totals = {"parallel_closes": 0, "sequential_closes": 0, "aborts": 0,
-              "unplanned": 0, "sessions": 0}
+              "unplanned": 0, "native_hits": 0, "native_declines": 0,
+              "sessions": 0}
     reasons = []
     try:
         with open(stats_path, errors="replace") as f:
@@ -117,7 +124,8 @@ def run_parallel_smoke(cmd: str) -> "tuple":
                     continue
                 totals["sessions"] += 1
                 for k in ("parallel_closes", "sequential_closes",
-                          "aborts", "unplanned"):
+                          "aborts", "unplanned", "native_hits",
+                          "native_declines"):
                     totals[k] += int(row.get(k, 0))
                 reasons.extend(row.get("escape_reasons", []))
     except OSError:
@@ -125,6 +133,8 @@ def run_parallel_smoke(cmd: str) -> "tuple":
     summary = (f"{totals['parallel_closes']} parallel closes, "
                f"{totals['aborts']} aborts, "
                f"{totals['unplanned']} unplanned, "
+               f"{totals['native_hits']} native hits, "
+               f"{totals['native_declines']} declines, "
                f"{totals['sessions']} app sessions")
     if reasons:
         summary += f"; escapes: {reasons[:4]}"
@@ -135,6 +145,7 @@ def main() -> int:
     timings = "--timings" in sys.argv
     smoke_only = "--parallel-smoke-only" in sys.argv
     skip_smoke = "--skip-parallel-smoke" in sys.argv
+    skip_fallback = "--skip-fallback-smoke" in sys.argv
     if smoke_only:
         cmd = tier1_command()
         problems, passed, summary = run_parallel_smoke(cmd)
@@ -193,6 +204,16 @@ def main() -> int:
               flush=True)
         problems.extend(smoke_problems)
         smoke_note = f"parallel smoke passed={smoke_passed}"
+        if not skip_fallback:
+            # NATIVE_APPLY=0 fallback parity: the Python reference
+            # apply alone must keep the suite green (every kernel
+            # decline lands on it in production)
+            fb_problems, fb_passed, fb_summary = run_parallel_smoke(
+                cmd, native=False)
+            print(f"verify_green: fallback-parity smoke: {fb_summary}",
+                  flush=True)
+            problems.extend(fb_problems)
+            smoke_note += f", fallback smoke passed={fb_passed}"
     if problems:
         print(f"verify_green: RED ({'; '.join(problems)}); "
               f"passed={passed}", flush=True)
