@@ -225,19 +225,25 @@ class ParallelApplyManager:
         inline_native = parallel_on and self.native_wanted and \
             bool(getattr(cfg, "NATIVE_APPLY_INLINE", False))
         self.enabled = pool_on or inline_native
+        # opt-in post-apply invariant pass over kernel-applied cluster
+        # deltas (ROADMAP 2e): configuring INVARIANT_CHECKS engages it,
+        # so chaos runs with INVARIANT_CHECKS=[".*"] cover the native
+        # path too.  Packed deltas decode lazily inside the checkers —
+        # an operator who opts out of checkers never pays the decode.
+        self.native_invariants = bool(
+            self.enabled and self.native_wanted
+            and getattr(app, "invariants", None) is not None
+            and app.invariants.invariants)
         if (self.enabled and self.native_wanted
                 and getattr(cfg, "INVARIANT_CHECKS", None)):
-            # surface the documented coverage tradeoff operationally:
-            # checkers run per-op on Python-applied clusters only, so an
-            # operator who configured them sees at startup that kernel
-            # clusters rely on the kernel's guards (NATIVE_APPLY=0 runs
-            # every checker on every tx; state bytes identical either way)
             from ..utils.logging import get_logger
 
             get_logger("Ledger").info(
-                "native apply kernel on: INVARIANT_CHECKS %s run on "
-                "Python-applied clusters only (NATIVE_APPLY=0 to check "
-                "every tx)", cfg.INVARIANT_CHECKS)
+                "native apply kernel on: INVARIANT_CHECKS %s run per-op "
+                "on Python-applied clusters and as a post-apply "
+                "cluster-delta pass on kernel-applied clusters "
+                "(NATIVE_APPLY=0 to run every checker per-op on every "
+                "tx)", cfg.INVARIANT_CHECKS)
         self.executor = None
         if pool_on:
             from concurrent.futures import ThreadPoolExecutor
@@ -512,6 +518,9 @@ class ParallelApplyManager:
                         nspan.args["outcome"] = "decline"
                         nspan.args["reason"] = decline_reason
             if native_res is not None:
+                if self.native_invariants:
+                    self._check_native_invariants(cluster, snapshot,
+                                                  native_res)
                 native_res.op_costs = {"native_kernel": [
                     nspan.seconds, len(cluster.indices)]}
                 native_res.span_seconds = nspan.seconds
@@ -551,6 +560,35 @@ class ParallelApplyManager:
             res.op_costs = op_costs.costs
         res.span_seconds = span.seconds
         return res
+
+    def _check_native_invariants(self, cluster, snapshot, res) -> None:
+        """Post-apply invariant pass over one kernel-applied cluster's
+        delta (ROADMAP 2e): rebuild the layer shape the checkers expect
+        — a LedgerTxn whose parent is the cluster's footprint view —
+        seed it with the kernel's packed delta (entries decode lazily on
+        first checker touch), and run every configured checker once at
+        cluster granularity.
+
+        A violation raises through the worker's escape machinery: the
+        parallel attempt aborts and the sequential replay re-runs the
+        same transactions through the Python reference apply with
+        per-op checkers — which either reproduces the violation (a real
+        bug: the close crashes, safety-first) or proves the kernel
+        diverged (the replay's bytes win)."""
+        from ..invariant.manager import InvariantDoesNotHold
+
+        view = ClusterView(snapshot, cluster, None)
+        ltx = LedgerTxn(view)
+        ltx._delta = dict(res.delta)
+        ltx._okeys = set(res.okeys)
+        if res.header is not None:
+            ltx.set_header(res.header)
+        try:
+            self.app.invariants.check_on_tx_apply(ltx, None, True)
+        except InvariantDoesNotHold as e:
+            self.app.metrics.counter("apply.native.invariant-fail").inc()
+            raise FootprintEscape(
+                f"native cluster invariant: {e}") from e
 
     @staticmethod
     def _post_check(cluster, snapshot, cluster_ltx) -> None:

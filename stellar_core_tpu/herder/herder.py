@@ -42,6 +42,12 @@ class HerderSCPDriver(SCPDriver):
     def __init__(self, herder):
         self.herder = herder
         self.app = herder.app
+        # overlay's cross-peer signature batch primes verdicts here so
+        # verify_envelope becomes a dict hit for batched envelopes
+        # (bounded FIFO; identical verdicts either way)
+        from collections import OrderedDict
+
+        self._sig_verdicts: "OrderedDict" = OrderedDict()
 
     # -- values ------------------------------------------------------------
 
@@ -109,15 +115,35 @@ class HerderSCPDriver(SCPDriver):
         from ..crypto import sha256
 
         env.signature = sk.sign(sha256(body))
+        # SCPEnvelope encodes are memoized; the signature write above is
+        # the type's one post-construction mutation — drop any memo so a
+        # pre-sign encode can never leak stale bytes
+        env.__dict__.pop("_xdr_enc", None)
 
-    def verify_envelope(self, env) -> bool:
-        from ..crypto import sha256, verify_sig
+    def envelope_sig_triple(self, env) -> tuple:
+        """(pubkey, signature, signed-payload-hash) of one envelope —
+        the unit the overlay's cross-peer signature batch verifies."""
+        from ..crypto import sha256
 
         body = T.EnvelopeType.encode(T.EnvelopeType.ENVELOPE_TYPE_SCP) + \
             self.app.config.network_id() + \
             T.SCPStatement.encode(env.statement)
-        return verify_sig(env.statement.nodeID.value, env.signature,
-                          sha256(body))
+        return (env.statement.nodeID.value, env.signature, sha256(body))
+
+    def prime_sig_verdicts(self, triple_verdicts) -> None:
+        for triple, ok in triple_verdicts:
+            self._sig_verdicts[triple] = bool(ok)
+        while len(self._sig_verdicts) > 8192:
+            self._sig_verdicts.popitem(last=False)
+
+    def verify_envelope(self, env) -> bool:
+        from ..crypto import verify_sig
+
+        triple = self.envelope_sig_triple(env)
+        cached = self._sig_verdicts.get(triple)
+        if cached is not None:
+            return cached
+        return verify_sig(*triple)
 
     def emit_envelope(self, env) -> None:
         self.herder.broadcast_scp(env)
@@ -134,7 +160,7 @@ class HerderSCPDriver(SCPDriver):
             old.cancel()
         if cb is None or timeout <= 0:
             return
-        t = VirtualTimer(self.app.clock)
+        t = VirtualTimer(self.app.clock, owner=self.app)
         t.expires_from_now(timeout)
         t.async_wait(cb)
         self.herder._scp_timers[key] = t
@@ -233,14 +259,14 @@ class Herder:
         self.quorum_tracker = QuorumTracker(cfg.node_id(), qset)
         self._heard_qsets: Dict[bytes, object] = {}
         self._scp_timers: Dict = {}
-        self.trigger_timer = VirtualTimer(app.clock)
+        self.trigger_timer = VirtualTimer(app.clock, owner=app)
         self.on_externalized: List[Callable] = []
         self._tracking_slot: Optional[int] = None
         # consensus failure detection (ref HerderImpl.cpp:432 +
         # CONSENSUS_STUCK_TIMEOUT_SECONDS, Herder.cpp:9): no externalize
         # within the stuck window => NOT_TRACKING + periodic recovery
-        self.tracking_timer = VirtualTimer(app.clock)
-        self.out_of_sync_timer = VirtualTimer(app.clock)
+        self.tracking_timer = VirtualTimer(app.clock, owner=app)
+        self.out_of_sync_timer = VirtualTimer(app.clock, owner=app)
         self.lost_sync_count = 0
 
     @staticmethod
@@ -362,8 +388,40 @@ class Herder:
 
     # -- SCP plumbing -------------------------------------------------------
 
+    def scp_slot_bracket(self) -> tuple:
+        """[min, max] slot indices this node will process SCP traffic
+        for (ref Herder::recvSCPEnvelope's minLedgerSeq/maxLedgerSeq
+        checks): below = already closed and purged (a stale replay would
+        re-create dead Slot objects forever), above = beyond the
+        validity bracket (a far-future flood would grow slot state
+        unboundedly).  The upper bound only applies while TRACKING —
+        like the reference's maxLedgerSeq — because a node that fell
+        far behind must still ingest live traffic to learn how far
+        behind it is and start catching up."""
+        lcl = self.app.ledger_manager.last_closed_seq()
+        lookback = max(SCP_EXTRA_LOOKBACK_LEDGERS,
+                       self.app.config.MAX_SLOTS_TO_REMEMBER)
+        hi = (lcl + LEDGER_VALIDITY_BRACKET
+              if self.state == HerderState.TRACKING else 2 ** 63)
+        return (max(1, lcl - lookback + 1), hi)
+
     def recv_scp_envelope(self, env) -> EnvelopeState:
         """ref recvSCPEnvelope :624 + PendingEnvelopes fetch logic."""
+        lo, hi = self.scp_slot_bracket()
+        slot = env.statement.slotIndex
+        if not lo <= slot <= hi:
+            # stale replay / far-future flood: discard without touching
+            # SCP state (the reference's DISCARDED status)
+            self.app.metrics.counter("herder.scp.discarded").inc()
+            return EnvelopeState.INVALID
+        if env.statement.nodeID.value == self.app.config.node_id():
+            # ref ENVELOPE_STATUS_SKIPPED_SELF: never ingest our own
+            # statements from the network — the local protocol already
+            # holds the authoritative copy, and a flooded-back variant
+            # (e.g. an equivocating twin signed while Byzantine) would
+            # supersede our record and wedge the next honest emission
+            self.app.metrics.counter("herder.scp.self-skipped").inc()
+            return EnvelopeState.VALID
         with self.app.tracer.span("herder.scp.recv",
                                   slot=env.statement.slotIndex):
             missing = self.pending_envelopes.missing_for(env)
@@ -496,6 +554,14 @@ class Herder:
         by the catchup manager when it drains buffered ledgers)."""
         lm = self.app.ledger_manager
         self.tx_queue.shift(lm.root)
+        if self.app.overlay_manager is not None:
+            # expire flood dedup records past their TTL (ref
+            # OverlayManager::clearLedgersBelow): without this the
+            # floodgate grows per flooded message forever AND absorbs
+            # stale replays that the slot bracket is supposed to
+            # discard — both surfaced by the chaos stale_replay
+            # scenario
+            self.app.overlay_manager.floodgate.clear_below(slot_index)
         self.scp.purge_slots(
             max(0, slot_index - max(SCP_EXTRA_LOOKBACK_LEDGERS,
                                     self.app.config.MAX_SLOTS_TO_REMEMBER)),

@@ -16,6 +16,11 @@ from .ledger_txn import LedgerTxn, LedgerTxnRoot, open_database
 
 GENESIS_LEDGER_SEQ = 1
 
+# last seq a deferred post-close collection ran for (process-global:
+# the interpreter has ONE gc, so one collection per closed seq covers
+# every co-hosted simulated node)
+_LAST_GC_SEQ = -1
+
 
 class LedgerCloseData:
     """(ledgerSeq, TxSetFrame, StellarValue) bundle handed from Herder
@@ -418,6 +423,14 @@ class LedgerManager:
         # garbage grows unboundedly
         if not (self.app.config.DEFERRED_GC or app_mod._GC_DEFERRED):
             return
+        # GC is process-wide: in a many-validator simulation every node
+        # closes the same seq back-to-back, and 50 identical collections
+        # per round (50 FULL ones at the seq%64 cadence) dominate wall
+        # time.  One collection per closed seq covers the whole process.
+        global _LAST_GC_SEQ
+        if seq == _LAST_GC_SEQ:
+            return
+        _LAST_GC_SEQ = seq
         import gc
 
         gc.collect(2 if seq % 64 == 0 else 1)

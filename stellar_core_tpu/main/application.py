@@ -140,7 +140,8 @@ class Application:
             # it races a peer's listener coming up
             from ..utils.clock import VirtualTimer
 
-            self._overlay_tick_timer = VirtualTimer(self.clock)
+            self._overlay_tick_timer = VirtualTimer(self.clock,
+                                                    owner=self)
             self._arm_overlay_tick()
         self.history_manager.publish_queued_history()
         self._started = True
@@ -271,7 +272,13 @@ class Application:
             self.connect_known_peers()
         self._arm_overlay_tick()
 
-    def graceful_stop(self) -> None:
+    def stop_node(self) -> None:
+        """Tear down THIS node's subsystems without touching the clock —
+        the clock may be shared by a whole simulated network (chaos
+        crash-restore kills one validator while the rest keep cranking).
+        Every timer tagged with this app is swept so no callback fires
+        into freed subsystems; on-disk state (DATABASE file + bucket
+        store) survives for a restart-from-state rebuild."""
         self.process_manager.shutdown()
         self.parallel_apply.shutdown()
         self.bucket_manager.shutdown()
@@ -281,6 +288,12 @@ class Application:
             self.peer_door.close()
         if self.http_server is not None:
             self.http_server.close()
+        self.clock.cancel_owner(self)
+        self.database.close()
+        self._started = False
+
+    def graceful_stop(self) -> None:
+        self.stop_node()
         self.clock.stop()
 
     # -- cross-subsystem plumbing ------------------------------------------

@@ -134,6 +134,13 @@ class Config:
         # PREFERRED_PEERS)
         self.PREFERRED_PEERS: List[str] = kw.get("PREFERRED_PEERS", [])
 
+        # cross-peer SCP signature-batch admission: flooded envelopes
+        # received within one crank verify as a single padded batch
+        # (SIG_BATCH_BUCKETS) instead of per-envelope inside SCP —
+        # verdicts identical either way, the device just sees one
+        # dispatch (ROADMAP 4 companion)
+        self.OVERLAY_SIG_BATCH: bool = kw.get("OVERLAY_SIG_BATCH", True)
+
         # work/process subsystem (ref MAX_CONCURRENT_SUBPROCESSES)
         self.MAX_CONCURRENT_SUBPROCESSES: int = kw.get(
             "MAX_CONCURRENT_SUBPROCESSES", 16)

@@ -91,6 +91,14 @@ class OverlayManager:
             self.peer_manager = None
             self.ban_manager = None
         self._shutting_down = False
+        # cross-peer signature-batch admission (ROADMAP 4 companion):
+        # flooded SCP envelopes accumulate here within a crank and their
+        # signatures verify as ONE batch through the fixed
+        # SIG_BATCH_BUCKETS instead of per-envelope inside SCP
+        self._scp_inbox: List = []
+        self._scp_drain_posted = False
+        self._sig_batching = bool(getattr(app.config,
+                                          "OVERLAY_SIG_BATCH", True))
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -197,8 +205,77 @@ class OverlayManager:
             if not self.floodgate.add_record(msg, peer.peer_id,
                                              self._ledger_seq()):
                 return
-            self.app.herder.recv_scp_envelope(scp_env)
+            if not self._sig_batching:
+                self.app.herder.recv_scp_envelope(scp_env)
+                self.broadcast_message(msg)
+                return
+            # defer delivery to the end-of-crank drain so every peer's
+            # envelopes this crank share one signature batch; forward
+            # NOW (same as the direct path: forwarding never waited on
+            # local verification)
+            self._scp_inbox.append(scp_env)
             self.broadcast_message(msg)
+            if not self._scp_drain_posted:
+                self._scp_drain_posted = True
+                self.app.clock.post_action(self._drain_scp_inbox)
+
+    def _drain_scp_inbox(self) -> None:
+        """Batch-verify the crank's accumulated SCP envelope signatures
+        (padded to the fixed SIG_BATCH_BUCKETS on the device tier), prime
+        the herder driver's verdict cache, then deliver in arrival
+        order — results identical to per-envelope verification, the
+        device just sees one padded batch instead of N scalar calls."""
+        self._scp_drain_posted = False
+        batch, self._scp_inbox = self._scp_inbox, []
+        if not batch or self._shutting_down:
+            return
+        herder = self.app.herder
+        with self.app.tracer.span("overlay.recv.sigbatch",
+                                  n_envs=len(batch)):
+            # out-of-bracket envelopes get discarded unverified by the
+            # herder — don't pay batch slots for them (a stale-replay
+            # storm must not buy device work with dead envelopes)
+            lo, hi = herder.scp_slot_bracket()
+            triples = [herder.driver.envelope_sig_triple(env)
+                       for env in batch
+                       if lo <= env.statement.slotIndex <= hi]
+            verdicts = self._verify_triples(triples)
+            herder.driver.prime_sig_verdicts(zip(triples, verdicts))
+            self.app.metrics.counter("overlay.sigbatch.batches").inc()
+            self.app.metrics.counter("overlay.sigbatch.envelopes").inc(
+                len(batch))
+        for env in batch:
+            herder.recv_scp_envelope(env)
+
+    def _verify_triples(self, triples) -> List[bool]:
+        """[(pub, sig, msg32)] -> verdicts; one padded device batch when
+        the node runs the TPU crypto backend, the (process-cached) host
+        chokepoint otherwise."""
+        well_formed = all(len(t[0]) == 32 and len(t[1]) == 64
+                          for t in triples)
+        if self.app.config.CRYPTO_BACKEND == "tpu" and \
+                len(triples) >= 2 and well_formed:
+            import numpy as np
+
+            from ..ops.ed25519_kernel import verify_batch
+            from ..utils.device import pad_signature_batch
+
+            n = len(triples)
+            pk = np.frombuffer(b"".join(t[0] for t in triples),
+                               np.uint8).reshape(n, 32)
+            sg = np.frombuffer(b"".join(t[1] for t in triples),
+                               np.uint8).reshape(n, 64)
+            mg = np.frombuffer(b"".join(t[2] for t in triples),
+                               np.uint8).reshape(n, 32)
+            padded = pad_signature_batch(n)
+            if padded != n:
+                idx = np.arange(padded) % n
+                pk, sg, mg = pk[idx], sg[idx], mg[idx]
+            ok = np.asarray(verify_batch(pk, sg, mg))[:n]
+            return [bool(v) for v in ok]
+        from ..crypto import verify_sig
+
+        return [verify_sig(p, s, m) for p, s, m in triples]
 
     def recv_get_tx_set(self, peer, h: bytes) -> None:
         ts = self.app.herder.pending_envelopes.get_tx_set(h)
@@ -235,8 +312,15 @@ class OverlayManager:
         self.app.herder.recv_qset(qset)
 
     def recv_get_scp_state(self, peer, ledger_seq: int) -> None:
+        """ref HerderImpl::sendSCPStateToPeer: answer with the FULL
+        remembered state (every node's latest envelopes) for slots the
+        requester asked for — a rejoining node's direct peers are not
+        v-blocking on sparse topologies, so self-only answers could
+        never get it past its missed slots."""
         for slot_index in sorted(self.app.herder.scp.slots):
-            for env in self.app.herder.scp.get_latest_messages_send(
+            if slot_index < ledger_seq:
+                continue
+            for env in self.app.herder.scp.get_current_state_envelopes(
                     slot_index):
                 peer.send_message(O.StellarMessage.make(
                     O.MessageType.SCP_MESSAGE, env))

@@ -351,46 +351,101 @@ class Peer:
         }
 
 
+class LinkChaos:
+    """Per-direction deterministic fault state of one loopback link —
+    the promoted form of the reference LoopbackPeer's damage knobs
+    (ref src/overlay/test/LoopbackPeer.h setDamageCert/Drop/Duplicate).
+
+    The RNG is supplied by the caller (simulation/chaos.py derives one
+    per link-direction from the chaos seed) so every fault decision is
+    a pure function of (chaos seed, message sequence) — never wall
+    entropy.  ``cut`` models a partition: total deterministic loss,
+    counted separately from probabilistic drops."""
+
+    __slots__ = ("drop", "damage", "duplicate", "latency", "cut", "rng")
+
+    def __init__(self, rng, drop: float = 0.0, damage: float = 0.0,
+                 duplicate: float = 0.0, latency: float = 0.0,
+                 cut: bool = False):
+        self.rng = rng
+        self.drop = drop
+        self.damage = damage
+        self.duplicate = duplicate
+        self.latency = latency
+        self.cut = cut
+
+
 class LoopbackPeer(Peer):
     """In-memory transport: writes enqueue into the partner's inbox,
     drained via clock actions — deterministic in-process networks
-    (ref src/overlay/test/LoopbackPeer.h).  Damage/drop/duplicate knobs
-    support fault injection like the reference."""
+    (ref src/overlay/test/LoopbackPeer.h).  A ``LinkChaos`` attached to
+    the sending side injects deterministic drop/damage/duplicate/
+    latency/partition faults, counter-instrumented under
+    ``overlay.chaos.*`` in /metrics (JSON + Prometheus)."""
 
     def __init__(self, app, role: PeerRole):
         super().__init__(app, role)
         self.partner: Optional["LoopbackPeer"] = None
-        self.drop_probability = 0.0
-        self.damage_probability = 0.0
-        self.duplicate_probability = 0.0
-        self._rng = None
+        self.chaos: Optional[LinkChaos] = None
 
     def set_damage(self, drop=0.0, damage=0.0, duplicate=0.0, seed=7):
+        """Legacy knob surface: probabilistic faults with a caller-chosen
+        seed.  Chaos scenarios use ``set_chaos`` with an engine-derived
+        RNG instead."""
         import random
 
-        self.drop_probability = drop
-        self.damage_probability = damage
-        self.duplicate_probability = duplicate
-        self._rng = random.Random(seed)
+        self.chaos = LinkChaos(random.Random(seed), drop=drop,
+                               damage=damage, duplicate=duplicate)
+
+    def set_chaos(self, chaos: Optional[LinkChaos]) -> None:
+        self.chaos = chaos
+
+    def _chaos_count(self, what: str) -> None:
+        self.app.metrics.counter(f"overlay.chaos.{what}").inc()
 
     def transport_write(self, data: bytes) -> None:
         if self.partner is None or self.partner.state == PeerState.CLOSING:
             return
         deliveries = [data]
-        if self._rng is not None:
-            if self._rng.random() < self.drop_probability:
+        chaos = self.chaos
+        latency = 0.0
+        if chaos is not None:
+            if chaos.cut:
+                self._chaos_count("cut")
+                return
+            # decision order is part of the determinism contract: one
+            # drop draw, one duplicate draw (only if not dropped), one
+            # damage draw (only if something still delivers)
+            if chaos.rng.random() < chaos.drop:
+                self._chaos_count("dropped")
                 deliveries = []
-            elif self._rng.random() < self.duplicate_probability:
+            elif chaos.rng.random() < chaos.duplicate:
+                self._chaos_count("duplicated")
                 deliveries = [data, data]
-            if deliveries and self._rng.random() < self.damage_probability:
+            if deliveries and chaos.rng.random() < chaos.damage:
+                self._chaos_count("damaged")
                 b = bytearray(deliveries[0])
-                b[self._rng.randrange(len(b))] ^= 0xFF
+                b[chaos.rng.randrange(len(b))] ^= 0xFF
                 deliveries[0] = bytes(b)
+            latency = chaos.latency
         partner = self.partner
         for d in deliveries:
-            self.app.clock.post_action(
-                lambda d=d: partner.recv_bytes(d)
-                if partner.state != PeerState.CLOSING else None)
+            if latency > 0.0:
+                # deliver through a one-shot timer: the virtual clock
+                # orders (deadline, arm-sequence), so equal-latency
+                # messages keep send order and the delay is exact
+                from ..utils.clock import VirtualTimer
+
+                self._chaos_count("delayed")
+                t = VirtualTimer(self.app.clock, owner=self.app)
+                t.expires_from_now(latency)
+                t.async_wait(
+                    lambda d=d: partner.recv_bytes(d)
+                    if partner.state != PeerState.CLOSING else None)
+            else:
+                self.app.clock.post_action(
+                    lambda d=d: partner.recv_bytes(d)
+                    if partner.state != PeerState.CLOSING else None)
 
 
 def make_loopback_pair(app1, app2):

@@ -164,6 +164,56 @@ class BallotProtocol:
         self.timer_exp_count += 1
         self.abandon_ballot(0)
 
+    def set_state_from_envelope(self, envelope) -> None:
+        """Restore this node's OWN ballot state from a persisted envelope
+        (ref BallotProtocol::setStateFromEnvelope) — the restart-from-
+        state path.  Without this a restarted validator records its
+        pre-crash statement but runs the protocol from scratch, and its
+        first fresh emission is older than its own recorded statement —
+        the self-process then refuses it and the node crashes ("moved to
+        a bad state"), which the chaos kill-restore scenario exposed.
+
+        Only legal before the protocol started; ignored (like the
+        reference's throw, minus the crash) otherwise."""
+        if self.current is not None:
+            return
+        st = envelope.statement
+        t = pledge_type(st)
+        p = st.pledges.value
+        if t == S.ST_PREPARE:
+            b = ballot_from_xdr(p.ballot)
+            self._bump_to_ballot(b, True)
+            if p.prepared is not None:
+                self.prepared = ballot_from_xdr(p.prepared)
+            if p.preparedPrime is not None:
+                self.prepared_prime = ballot_from_xdr(p.preparedPrime)
+            if p.nH:
+                self.high = (p.nH, b[1])
+            if p.nC:
+                self.commit = (p.nC, b[1])
+            self.phase = Phase.PREPARE
+        elif t == S.ST_CONFIRM:
+            b = ballot_from_xdr(p.ballot)
+            v = b[1]
+            self._bump_to_ballot(b, True)
+            self.prepared = (p.nPrepared, v)
+            self.high = (p.nH, v)
+            self.commit = (p.nCommit, v)
+            self.phase = Phase.CONFIRM
+        elif t == S.ST_EXTERNALIZE:
+            cb = ballot_from_xdr(p.commit)
+            v = cb[1]
+            self._bump_to_ballot((UINT32_MAX, v), True)
+            self.prepared = (UINT32_MAX, v)
+            self.high = (p.nH, v)
+            self.commit = cb
+            self.phase = Phase.EXTERNALIZE
+        else:
+            return
+        self.latest_envelopes[node_of(st)] = envelope
+        self.last_envelope = envelope
+        self.last_envelope_emit = envelope
+
     # -- state maintenance -------------------------------------------------
 
     def _update_current_value(self, ballot: Ballot) -> bool:

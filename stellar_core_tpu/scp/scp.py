@@ -62,6 +62,12 @@ class SCP:
         s = self.get_slot(slot_index, create=False)
         return s.latest_messages_send() if s is not None else []
 
+    def get_current_state_envelopes(self, slot_index: int) -> List:
+        """Full remembered state of one slot — every node's latest
+        envelopes, for answering GET_SCP_STATE (ref processCurrentState)."""
+        s = self.get_slot(slot_index, create=False)
+        return s.current_state_envelopes() if s is not None else []
+
     def empty(self) -> bool:
         return not self.slots
 

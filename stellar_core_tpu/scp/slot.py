@@ -103,18 +103,41 @@ class Slot:
         record, ref Slot::getLatestMessagesSend)."""
         return list(self.ballot.latest_envelopes.values())
 
+    def current_state_envelopes(self) -> list:
+        """EVERY remembered node's latest nomination + ballot envelopes,
+        in canonical node order — the GET_SCP_STATE payload (ref
+        Slot::processCurrentState feeding HerderImpl::sendSCPStateToPeer).
+        Answering with only the local node's own messages is not enough
+        on sparse topologies: a restarted validator's direct peers are
+        not v-blocking for a tiered org quorum, so it could never accept
+        the missed slots' outcomes and would stay wedged at its
+        pre-crash LCL forever (chaos crash_restore on
+        hierarchical_quorum exposed this).  Self-only when this slot is
+        not fully validated, like the reference."""
+        if not self.fully_validated:
+            return self.latest_messages_send()
+        by_node = dict(self.nomination.latest_nominations)
+        out = sorted(by_node.items())
+        out.extend(sorted(self.ballot.latest_envelopes.items()))
+        return [env for _, env in out]
+
     def set_state_from_envelope(self, envelope) -> None:
         """Restore persisted statement state WITHOUT driving protocol
         transitions (ref Slot::setStateFromEnvelope — used by
         Herder::restoreSCPState after a restart): the envelope becomes
         the node's recorded latest message so GET_SCP_STATE and
-        re-broadcast work, but no attempt* logic runs."""
+        re-broadcast work, but no attempt* logic runs.  For the local
+        node's OWN envelope the ballot protocol's b/p/p'/c/h/phase are
+        rebuilt too — otherwise the restarted protocol runs from scratch
+        and its first fresh emission is older than its own recorded
+        statement, which the self-process refuses ("moved to a bad
+        state", exposed by the chaos kill-restore scenario)."""
         st = envelope.statement
         if st.slotIndex != self.slot_index:
             raise ValueError("envelope for wrong slot")
-        self.ballot.latest_envelopes[node_of(st)] = envelope
         if node_of(st) == self.local_node.node_id:
-            self.ballot.last_envelope_emit = envelope
+            self.ballot.set_state_from_envelope(envelope)
+        self.ballot.latest_envelopes[node_of(st)] = envelope
 
     # -- federated voting --------------------------------------------------
 

@@ -1,2 +1,4 @@
 """In-process multi-node simulation (ref src/simulation — SURVEY.md §4.2)."""
-from .simulation import Simulation, core, cycle, pair  # noqa: F401
+from .simulation import (  # noqa: F401
+    Simulation, core, cycle, hierarchical_quorum, pair,
+)

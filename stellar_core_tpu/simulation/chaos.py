@@ -1,0 +1,668 @@
+"""Chaos engine: deterministic fault injection over simulated networks.
+
+Everything here is driven by ONE chaos seed: per-link fault RNGs derive
+from it (sha256(seed || endpoints || epoch)), scenario event times are
+virtual-clock timers, and Byzantine behavior is scripted — so a chaos
+run is a pure function of (topology, scenario, seed) and re-running it
+reproduces the exact same per-node ledger-hash sequences.  That
+determinism is itself asserted (``fingerprint`` + the seed-determinism
+tests): a heisen-failure under chaos would be worthless evidence.
+
+Fault taxonomy (ref the reference's LoopbackPeer damage knobs +
+Simulation-based HerderTests, scaled into scripted scenarios):
+
+- **link faults** — per-direction drop/damage/duplicate probabilities
+  and latency on loopback links (``LinkChaos`` in overlay/peer.py).
+  Drop/damage/duplicate break the authenticated MAC sequence exactly
+  like a torn TCP stream, so connections die and the engine's link
+  maintenance re-dials them (connection churn is part of the chaos).
+- **partitions** — ``partition(groups)`` cuts every link crossing group
+  boundaries (total deterministic loss, counted ``overlay.chaos.cut``);
+  ``heal()`` restores wiring and starts the time-to-heal stopwatch.
+- **crash / kill-restore** — ``crash(node)`` tears the Application down
+  mid-flight (shared clock survives, on-disk state survives);
+  ``restore(node)`` rebuilds from disk via the restart-from-state path
+  and re-wires its topology links.
+- **laggards** — ``lag(node, seconds)`` adds symmetric latency to every
+  link of one node.
+- **Byzantine actors** — ``equivocate(node)`` wraps a captured
+  validator's broadcast so every SCP emission is accompanied by a
+  conflicting variant (same slot, same txSetHash, bumped closeTime)
+  signed with the node's real key, sent to disjoint halves of its
+  peers; ``replay_stale(attacker, ...)`` re-floods envelopes captured
+  rounds ago (honest nodes must discard them via the herder's slot
+  bracket, not re-grow SCP state).
+
+Safety oracle after every scenario: zero forks among honest survivors
+(header-chain AND bucket-hash agreement via ``Simulation.assert_no_forks``),
+no invariant violations (sim nodes run ``INVARIANT_CHECKS=[".*"]`` — a
+violation crashes the close and therefore the scenario), and liveness:
+the surviving quorum kept closing and the network converged after the
+faults cleared (``time_to_heal``).
+"""
+from __future__ import annotations
+
+import random
+import time as _wall
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..crypto import sha256
+from ..overlay.peer import LinkChaos, PeerState
+from ..utils.clock import VirtualTimer
+from ..xdr import overlay_types as O
+from ..xdr import types as T
+from .simulation import Simulation
+
+
+class LinkPolicy:
+    """The engine's intended fault state for one (a, b) link; re-applied
+    whenever link maintenance re-dials the pair."""
+
+    __slots__ = ("drop", "damage", "duplicate", "latency", "cut")
+
+    def __init__(self):
+        self.drop = 0.0
+        self.damage = 0.0
+        self.duplicate = 0.0
+        self.latency = 0.0
+        self.cut = False
+
+    def active(self) -> bool:
+        return bool(self.cut or self.drop or self.damage
+                    or self.duplicate or self.latency)
+
+
+class ChaosEngine:
+    """Seeded fault scheduler over one ``Simulation``."""
+
+    MAINTENANCE_PERIOD = 1.0  # virtual seconds between re-dial sweeps
+
+    def __init__(self, sim: Simulation, seed: int):
+        self.sim = sim
+        self.seed = int(seed)
+        self.rng = random.Random(self.seed)
+        self.byzantine: set = set()
+        self.policies: Dict[Tuple[bytes, bytes], LinkPolicy] = {}
+        self._link_epoch: Dict[Tuple[bytes, bytes], int] = {}
+        self.reconnects = 0
+        self.equivocations = 0
+        self.replayed = 0
+        self.events: List[Tuple[float, str]] = []
+        # node -> seq -> (virtual time, wall time) of local externalize
+        self.extern_times: Dict[bytes, Dict[int, Tuple[float, float]]] = {}
+        # node -> seq -> header hash at externalize (the live fork/
+        # determinism record; the DB header chain is the post-hoc oracle)
+        self.extern_hashes: Dict[bytes, Dict[int, bytes]] = {}
+        self._capture: List = []  # (slot, envelope) log for stale replay
+        self._timers: List[VirtualTimer] = []
+        self._maint_timer: Optional[VirtualTimer] = None
+        # virtual time the last fault was cleared (heal/unlag/restore) —
+        # the time-to-heal stopwatch's zero
+        self.last_clear_time: float = sim.clock.now()
+        for nid in sim.nodes:
+            self._hook_node(nid)
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def log_event(self, what: str) -> None:
+        self.events.append((round(self.sim.clock.now(), 3), what))
+
+    def _hook_node(self, nid: bytes) -> None:
+        app = self.sim.nodes[nid]
+        times = self.extern_times.setdefault(nid, {})
+        hashes = self.extern_hashes.setdefault(nid, {})
+
+        def on_ext(slot, sv, app=app, times=times, hashes=hashes):
+            lm = app.ledger_manager
+            if lm.last_closed_seq() >= slot:
+                times.setdefault(
+                    slot, (self.sim.clock.now(), _wall.monotonic()))
+                if lm.last_closed_seq() == slot:
+                    hashes.setdefault(slot, lm.last_closed_hash())
+
+        app.herder.on_externalized.append(on_ext)
+
+    def _link_rng(self, a: bytes, b: bytes) -> random.Random:
+        epoch = self._link_epoch.get((a, b), 0)
+        self._link_epoch[(a, b)] = epoch + 1
+        material = sha256(b"chaos-link-%d-%d" % (self.seed, epoch) + a + b)
+        return random.Random(int.from_bytes(material, "big"))
+
+    def _key(self, a: bytes, b: bytes) -> Tuple[bytes, bytes]:
+        """Canonical (a, b) orientation: the one the topology recorded."""
+        return (b, a) if (b, a) in self.sim.topology else (a, b)
+
+    def _policy(self, a: bytes, b: bytes) -> LinkPolicy:
+        return self.policies.setdefault(self._key(a, b), LinkPolicy())
+
+    def _apply_policy(self, key: Tuple[bytes, bytes]) -> None:
+        policy = self.policies.get(key)
+        for peer in self.sim.link_peers(*key):
+            if policy is None or not policy.active():
+                peer.set_chaos(None)
+                continue
+            a, b = key
+            peer.set_chaos(LinkChaos(
+                self._link_rng(a, b), drop=policy.drop,
+                damage=policy.damage, duplicate=policy.duplicate,
+                latency=policy.latency, cut=policy.cut))
+
+    # -- link faults ---------------------------------------------------------
+
+    def set_link(self, a: bytes, b: bytes, drop: float = 0.0,
+                 damage: float = 0.0, duplicate: float = 0.0,
+                 latency: float = 0.0, cut: Optional[bool] = None) -> None:
+        policy = self._policy(a, b)
+        policy.drop = drop
+        policy.damage = damage
+        policy.duplicate = duplicate
+        policy.latency = latency
+        if cut is not None:
+            policy.cut = cut
+        self._apply_policy(self._key(a, b))
+
+    def clear_links(self) -> None:
+        """Drop every probabilistic fault and latency; cuts (partitions)
+        persist until ``heal``."""
+        for key, policy in self.policies.items():
+            policy.drop = policy.damage = policy.duplicate = 0.0
+            policy.latency = 0.0
+            self._apply_policy(key)
+        self.last_clear_time = self.sim.clock.now()
+        self.log_event("links cleared")
+
+    def partition(self, groups: List[List[bytes]]) -> None:
+        """Cut every link whose endpoints land in different groups (nodes
+        in no group keep all their links)."""
+        side = {}
+        for gi, group in enumerate(groups):
+            for nid in group:
+                side[nid] = gi
+        n_cut = 0
+        for a, b in self.sim.topology:
+            if a in side and b in side and side[a] != side[b]:
+                policy = self._policy(a, b)
+                if not policy.cut:
+                    policy.cut = True
+                    n_cut += 1
+                self._apply_policy(self._key(a, b))
+        self.log_event(f"partition: {len(groups)} groups, {n_cut} links cut")
+
+    def heal(self) -> None:
+        for key, policy in self.policies.items():
+            policy.cut = False
+            self._apply_policy(key)
+        self.last_clear_time = self.sim.clock.now()
+        self.log_event("heal")
+
+    def lag(self, nid: bytes, latency: float) -> None:
+        for a, b in self.sim.topology:
+            if nid in (a, b):
+                policy = self._policy(a, b)
+                policy.latency = latency
+                self._apply_policy(self._key(a, b))
+        if latency:
+            self.log_event(f"lag {nid.hex()[:8]} by {latency}s")
+        else:
+            self.last_clear_time = self.sim.clock.now()
+            self.log_event(f"unlag {nid.hex()[:8]}")
+
+    # -- crash / restore -----------------------------------------------------
+
+    def crash(self, nid: bytes) -> None:
+        self.sim.crash_node(nid)
+        self.log_event(f"crash {nid.hex()[:8]}")
+
+    def restore(self, nid: bytes) -> None:
+        self.sim.restart_node(nid)
+        self._hook_node(nid)
+        for key in self.policies:
+            if nid in key:
+                self._apply_policy(key)
+        self.last_clear_time = self.sim.clock.now()
+        self.log_event(f"restore {nid.hex()[:8]}")
+
+    # -- link maintenance (reconnect churn) ---------------------------------
+
+    def start_maintenance(self) -> None:
+        """Periodically re-dial topology links whose connection died —
+        drop/damage/duplicate faults break the authenticated MAC stream
+        by design, so sustained probabilistic chaos NEEDS reconnection
+        for the network to stay live (the churn is part of the test)."""
+        if self._maint_timer is None:
+            self._maint_timer = VirtualTimer(self.sim.clock, owner=self)
+        self._arm_maintenance()
+
+    def _arm_maintenance(self) -> None:
+        t = self._maint_timer
+        t.cancel()
+        t.expires_from_now(self.MAINTENANCE_PERIOD)
+        t.async_wait(self._maintain_links)
+
+    def _maintain_links(self) -> None:
+        self.maintain_links_once()
+        self._arm_maintenance()
+
+    def maintain_links_once(self) -> int:
+        sim = self.sim
+        redialed = 0
+        for a, b in sim.topology:
+            if sim.crashed.get(a) or sim.crashed.get(b):
+                continue
+            peers = sim.link_peers(a, b)
+            dead = not peers or any(
+                p.state == PeerState.CLOSING for p in peers)
+            if not dead:
+                continue
+            for p in peers:
+                if p.state != PeerState.CLOSING:
+                    p.close("chaos re-dial")
+            sim.links.pop((a, b), None)
+            sim.links.pop((b, a), None)
+            sim._wire(a, b)
+            key = (a, b) if (a, b) in self.policies else (b, a)
+            if key in self.policies:
+                self._apply_policy(key)
+            redialed += 1
+        self.reconnects += redialed
+        return redialed
+
+    def stop(self) -> None:
+        if self._maint_timer is not None:
+            self._maint_timer.cancel()
+        for t in self._timers:
+            t.cancel()
+        self.sim.clock.cancel_owner(self)
+
+    # -- Byzantine actors ----------------------------------------------------
+
+    def equivocate(self, nid: bytes) -> None:
+        """Turn ``nid`` into an equivocator: every SCP emission goes out
+        in two conflicting variants (original + closeTime-bumped value,
+        both properly signed) to disjoint halves of its peers, bypassing
+        the floodgate so the halves really do see different statements.
+        Honest forwarding then spreads both network-wide."""
+        app = self.sim.nodes[nid]
+        self.byzantine.add(nid)
+        engine = self
+
+        def equivocating_broadcast(env, app=app):
+            alt = engine._perturb_envelope(app, env)
+            peers = sorted(app.overlay_manager.authenticated.values(),
+                           key=lambda p: p.peer_id or b"")
+            if alt is not None:
+                engine.equivocations += 1
+            for i, p in enumerate(peers):
+                send = env if (alt is None or i % 2 == 0) else alt
+                p.send_message(O.StellarMessage.make(
+                    O.MessageType.SCP_MESSAGE, send))
+
+        app.broadcast_scp_message = equivocating_broadcast
+        self.log_event(f"equivocator {nid.hex()[:8]}")
+
+    def _sign_statement(self, app, st):
+        """Properly-signed envelope for a forged statement — through the
+        node's OWN driver (the equivocator holds its real key), so the
+        signed-body format lives in exactly one place."""
+        env = T.SCPEnvelope.make(statement=st, signature=b"")
+        app.herder.driver.sign_envelope(env)
+        return env
+
+    @staticmethod
+    def _bump_value(value: bytes) -> Optional[bytes]:
+        """A conflicting-but-valid variant of one consensus value: same
+        tx set, closeTime+1 — passes every honest validity check while
+        differing as a ballot/nomination value."""
+        try:
+            sv = T.StellarValue.decode(value)
+        except Exception:
+            return None
+        return T.StellarValue.encode(sv._replace(closeTime=sv.closeTime + 1))
+
+    def _perturb_envelope(self, app, env):
+        """Build the conflicting twin of one emitted envelope (fresh
+        statement + fresh signature; the original is never mutated)."""
+        st = env.statement
+        ST = T.SCPStatementType
+        p = st.pledges
+        try:
+            if p.type == ST.SCP_ST_NOMINATE:
+                nom = p.value
+                votes = [self._bump_value(v) or v for v in nom.votes]
+                accepted = [self._bump_value(v) or v
+                            for v in nom.accepted]
+                if votes == list(nom.votes) and \
+                        accepted == list(nom.accepted):
+                    return None
+                pledges = T.SCPStatement.fields[2][1].make(
+                    ST.SCP_ST_NOMINATE,
+                    nom._replace(votes=votes, accepted=accepted))
+            elif p.type == ST.SCP_ST_PREPARE:
+                prep = p.value
+                alt = self._bump_value(prep.ballot.value)
+                if alt is None:
+                    return None
+                pledges = T.SCPStatement.fields[2][1].make(
+                    ST.SCP_ST_PREPARE,
+                    prep._replace(ballot=prep.ballot._replace(value=alt)))
+            else:
+                # CONFIRM/EXTERNALIZE equivocation would require the
+                # node to have (claimed to have) accepted two commits —
+                # emit a conflicting PREPARE-stage history instead by
+                # leaving these untouched; nomination/prepare
+                # equivocation is where split views are seeded
+                return None
+        except Exception:
+            return None
+        return self._sign_statement(
+            app, st._replace(pledges=pledges))
+
+    # -- stale replay --------------------------------------------------------
+
+    def capture_scp(self, nid: bytes) -> None:
+        """Record every envelope ``nid`` broadcasts (still delivering it
+        normally) as future stale-replay ammunition."""
+        app = self.sim.nodes[nid]
+        orig = app.broadcast_scp_message
+        engine = self
+
+        def capturing_broadcast(env, orig=orig):
+            engine._capture.append((env.statement.slotIndex, env))
+            orig(env)
+
+        app.broadcast_scp_message = capturing_broadcast
+
+    def replay_stale(self, attacker: bytes, max_age_slot: int,
+                     limit: int = 64) -> int:
+        """Re-flood captured envelopes for slots <= ``max_age_slot`` from
+        ``attacker``'s connections.  Honest nodes must shed them (herder
+        slot bracket / floodgate) without re-growing SCP slot state."""
+        app = self.sim.nodes[attacker]
+        peers = sorted(app.overlay_manager.authenticated.values(),
+                       key=lambda p: p.peer_id or b"")
+        sent = 0
+        for slot, env in self._capture:
+            if slot > max_age_slot or sent >= limit:
+                continue
+            for p in peers:
+                p.send_message(O.StellarMessage.make(
+                    O.MessageType.SCP_MESSAGE, env))
+            sent += 1
+        self.replayed += sent
+        self.log_event(f"stale replay: {sent} envelopes from "
+                       f"{attacker.hex()[:8]}")
+        return sent
+
+    # -- aggregate counters --------------------------------------------------
+
+    def chaos_counters(self) -> Dict[str, int]:
+        out = {"dropped": 0, "damaged": 0, "duplicated": 0, "delayed": 0,
+               "cut": 0}
+        for app in self.sim.alive_nodes().values():
+            for k in out:
+                out[k] += app.metrics.counter(f"overlay.chaos.{k}").count
+        out["reconnects"] = self.reconnects
+        out["equivocations"] = self.equivocations
+        out["stale_replayed"] = self.replayed
+        out["stale_discarded"] = sum(
+            app.metrics.counter("herder.scp.discarded").count
+            for app in self.sim.alive_nodes().values())
+        return out
+
+    def honest_alive(self) -> List[bytes]:
+        return [nid for nid in self.sim.alive_nodes()
+                if nid not in self.byzantine]
+
+    def fingerprint(self) -> str:
+        """One hash over every honest node's externalized (seq, header
+        hash) sequence — the chaos-seed determinism contract: the same
+        (topology, scenario, seed) must reproduce this byte-for-byte."""
+        h = sha256(b"".join(
+            nid + seq.to_bytes(8, "big") + self.extern_hashes[nid][seq]
+            for nid in sorted(self.honest_alive())
+            for seq in sorted(self.extern_hashes.get(nid, {}))))
+        return h.hex()
+
+
+# ---------------------------------------------------------------------------
+# scenario runner
+# ---------------------------------------------------------------------------
+
+def _percentiles(values: List[float]) -> Dict[str, float]:
+    if not values:
+        return {"p50": 0.0, "p99": 0.0, "max": 0.0}
+    vs = sorted(values)
+
+    def pct(p: float) -> float:
+        i = min(len(vs) - 1, int(p * (len(vs) - 1) + 0.5))
+        return vs[i]
+
+    return {"p50": round(pct(0.50), 3), "p99": round(pct(0.99), 3),
+            "max": round(vs[-1], 3)}
+
+
+def run_scenario(make_sim: Callable[[], Simulation], seed: int,
+                 events: List[Tuple[float, str,
+                                    Callable[[ChaosEngine], None]]],
+                 duration: float, label: str,
+                 converge_timeout: float = 120.0) -> dict:
+    """Run one scripted chaos scenario end to end and return its report.
+
+    ``events`` is a list of (virtual-time offset, label, fn(chaos));
+    after ``duration`` virtual seconds of free-running consensus under
+    those faults the runner clears every remaining fault (heal + link
+    clear + restore of still-crashed nodes), waits for the honest
+    survivors to converge two more ledgers, and asserts the safety
+    contract: no forks (header chain + bucket hash), convergence within
+    ``converge_timeout`` virtual seconds.  An invariant violation or a
+    crash anywhere in a close raises out of the crank and fails the
+    scenario — those are P0s, not statistics.
+    """
+    sim = make_sim()
+    chaos = ChaosEngine(sim, seed)
+    sim.start_all_nodes()
+    while sim.crank():
+        pass  # handshakes settle at t=0
+    chaos.start_maintenance()
+    clock = sim.clock
+    t0 = clock.now()
+    for offset, elabel, fn in events:
+        t = VirtualTimer(clock, owner=chaos)
+        t.expires_from_now(max(0.0, (t0 + offset) - clock.now()))
+        t.async_wait(lambda fn=fn, elabel=elabel: (
+            chaos.log_event(f"event: {elabel}"), fn(chaos)))
+        chaos._timers.append(t)
+
+    t_end = t0 + duration
+    while clock.now() < t_end:
+        if clock.crank(block=True) == 0 and \
+                clock.next_deadline() is None:
+            break
+
+    # every scripted event must have fired inside the fault window — a
+    # scenario whose script outlives its duration silently tests
+    # nothing (the tiered stale_replay caught this: its replay timer
+    # was cancelled before firing and the run reported a clean pass)
+    fired = sum(1 for _, what in chaos.events
+                if what.startswith("event: "))
+    assert fired == len(events), (
+        f"[{label}] only {fired}/{len(events)} scripted events fired "
+        f"within duration {duration}s — extend the duration to cover "
+        f"the script")
+
+    # clear every remaining fault and start the heal stopwatch
+    for nid in [n for n, dead in sim.crashed.items() if dead]:
+        chaos.restore(nid)
+    chaos.heal()
+    chaos.clear_links()
+    chaos.maintain_links_once()
+    heal_start = max(chaos.last_clear_time, clock.now())
+    honest = chaos.honest_alive()
+    target = max(sim.nodes[n].ledger_manager.last_closed_seq()
+                 for n in honest) + 2
+
+    def converged() -> bool:
+        hashes = set()
+        for nid in honest:
+            rec = chaos.extern_hashes.get(nid, {})
+            if target not in rec:
+                return False
+            hashes.add(rec[target])
+        return len(hashes) == 1
+
+    deadline = heal_start + converge_timeout
+    while clock.now() < deadline and not converged():
+        if clock.crank(block=True) == 0 and \
+                clock.next_deadline() is None:
+            break
+    assert converged(), (
+        f"[{label}] honest survivors failed to converge on seq {target} "
+        f"within {converge_timeout}s virtual: "
+        f"{[(n.hex()[:8], sim.nodes[n].ledger_manager.last_closed_seq()) for n in honest]}")
+    # healed when the LAST honest node externalized the target seq
+    time_to_heal = round(
+        max(0.0, max(
+            chaos.extern_times[n][target][0] for n in honest
+            if target in chaos.extern_times.get(n, {})) - heal_start), 3)
+    chaos.stop()
+
+    # safety: full header-chain + bucket-hash agreement, all honest pairs
+    fork_comparisons = sim.assert_no_forks(honest)
+
+    # close-latency statistics over the whole run
+    spread_ms: List[float] = []
+    wall_ms: List[float] = []
+    cadence_s: List[float] = []
+    all_seqs = sorted({s for nid in honest
+                       for s in chaos.extern_times.get(nid, {})})
+    prev_wall_end = None
+    for s in all_seqs:
+        vts = [chaos.extern_times[nid][s][0] for nid in honest
+               if s in chaos.extern_times.get(nid, {})]
+        wts = [chaos.extern_times[nid][s][1] for nid in honest
+               if s in chaos.extern_times.get(nid, {})]
+        if len(vts) >= 2:
+            spread_ms.append((max(vts) - min(vts)) * 1000.0)
+        if prev_wall_end is not None:
+            wall_ms.append((max(wts) - prev_wall_end) * 1000.0)
+        prev_wall_end = max(wts)
+        cadence_s.append(max(vts))
+    cadence_diffs = [b - a for a, b in zip(cadence_s, cadence_s[1:])]
+
+    report = {
+        "scenario": label,
+        "seed": seed,
+        "nodes": len(sim.nodes),
+        "byzantine": len(chaos.byzantine),
+        "ledgers_closed": len(all_seqs),
+        "close_spread_virtual_ms": _percentiles(spread_ms),
+        "round_wall_ms": _percentiles(wall_ms),
+        "cadence_virtual_s": _percentiles(cadence_diffs),
+        "time_to_heal_s": time_to_heal,
+        "counters": chaos.chaos_counters(),
+        "fork_check": "pass",
+        "fork_comparisons": fork_comparisons,
+        "fingerprint": chaos.fingerprint(),
+        "events": chaos.events,
+    }
+    # release node resources (DB handles, pools) without stopping the
+    # clock mid-assert; the sim object dies with this frame
+    for nid in list(sim.alive_nodes()):
+        sim.nodes[nid].stop_node()
+    return report
+
+
+# ---------------------------------------------------------------------------
+# the canned scenario suite (tests + tools/chaos_bench.py share these)
+# ---------------------------------------------------------------------------
+
+def scenario_events(sim_ids: List[bytes], scenario: str,
+                    rng: random.Random) -> List[tuple]:
+    """Build the event script for one named scenario over the given node
+    ids (callers pass the topology's node order; victim choices draw
+    from ``rng`` so they derive from the chaos seed)."""
+    n = len(sim_ids)
+    if scenario == "partition_heal":
+        # cut off a minority third for a while, then heal
+        cut = rng.sample(sim_ids, max(1, n // 3))
+        keep = [x for x in sim_ids if x not in cut]
+        return [
+            (3.0, "partition minority",
+             lambda c, g=[keep, cut]: c.partition(g)),
+            (13.0, "heal", lambda c: c.heal()),
+        ]
+    if scenario == "crash_restore":
+        victim = rng.choice(sim_ids)
+        return [
+            (3.4, "crash mid-close",
+             lambda c, v=victim: c.crash(v)),
+            (9.0, "restore from state",
+             lambda c, v=victim: c.restore(v)),
+        ]
+    if scenario == "laggard":
+        victim = rng.choice(sim_ids)
+        return [
+            (2.0, "laggard +2.5s",
+             lambda c, v=victim: c.lag(v, 2.5)),
+            (12.0, "unlag", lambda c, v=victim: c.lag(v, 0.0)),
+        ]
+    if scenario == "flaky_links":
+        victims = rng.sample(sim_ids, max(2, n // 4))
+
+        def flake(c, vs=victims):
+            for v in vs:
+                for a, b in c.sim.topology:
+                    if v in (a, b):
+                        c.set_link(a, b, drop=0.02, duplicate=0.01,
+                                   damage=0.01)
+        return [
+            (2.0, "flaky links on", flake),
+            (12.0, "links clean", lambda c: c.clear_links()),
+        ]
+    if scenario == "stale_replay":
+        attacker = rng.choice(sim_ids)
+        # replay late enough that the earliest captured slots are BOTH
+        # past the floodgate's dedup TTL (so the replay isn't absorbed
+        # as a duplicate) and below the herder's slot bracket (so the
+        # discard path, not SCP, sheds them)
+        return [
+            (0.5, "capture scp",
+             lambda c, a=attacker: c.capture_scp(a)),
+            (16.0, "replay stale envelopes",
+             lambda c, a=attacker: c.replay_stale(
+                 a, max_age_slot=c.sim.nodes[a].ledger_manager
+                 .last_closed_seq() - 2)),
+        ]
+    if scenario == "equivocator":
+        # a Byzantine minority equivocates from the start
+        byz = rng.sample(sim_ids, max(1, (n - 1) // 4))
+        return [(1.0, f"equivocate x{len(byz)}",
+                 lambda c, bs=byz: [c.equivocate(b) for b in bs])]
+    raise ValueError(f"unknown scenario {scenario!r}")
+
+
+STANDARD_SCENARIOS = ("partition_heal", "crash_restore", "laggard",
+                      "flaky_links", "stale_replay", "equivocator")
+
+
+def run_standard_scenario(make_sim: Callable[[], Simulation],
+                          scenario: str, seed: int, n_nodes: int,
+                          duration: float = 20.0,
+                          converge_timeout: float = 120.0) -> dict:
+    """Resolve a named scenario against the canned topologies' node
+    order (node ids are a pure function of the node index, so no sim
+    needs building to know them) and run it.  The victim-choosing RNG
+    derives from (seed, scenario) so every scenario of a bench run is
+    independently deterministic."""
+    from .simulation import _ids, _seeds
+
+    ids = _ids(_seeds(n_nodes))
+    rng = random.Random(int.from_bytes(
+        sha256(b"chaos-scenario-%d-" % seed + scenario.encode()), "big"))
+    events = scenario_events(ids, scenario, rng)
+    # the fault window must cover the whole event script (plus slack
+    # for the last fault to bite) — otherwise late events like
+    # stale_replay's t=16 injection never fire on short-duration tiers
+    duration = max(duration, max(t for t, _, _ in events) + 2.0)
+    return run_scenario(make_sim, seed, events, duration, scenario,
+                        converge_timeout=converge_timeout)

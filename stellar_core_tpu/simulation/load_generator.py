@@ -289,7 +289,7 @@ class LoadGenerator:
             "cursor": 0, "carry": 0.0, "last": clock.now(),
             "running": True, "stopped": False,
         }
-        self._rate_timer = VirtualTimer(clock)
+        self._rate_timer = VirtualTimer(clock, owner=self.app)
         self._arm_rate_tick()
         return self.rate_status()
 

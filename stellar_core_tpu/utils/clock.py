@@ -124,6 +124,20 @@ class VirtualClock:
                 return pred()
         return pred()
 
+    def cancel_owner(self, owner: object) -> int:
+        """Cancel every armed timer tagged with ``owner`` — the teardown
+        path for one node on a SHARED clock (chaos crash-restore): a dead
+        Application's timers must never fire into freed subsystems while
+        the rest of the simulated network keeps cranking.  Returns the
+        number of timers cancelled."""
+        n = 0
+        for entry in list(self._timers):
+            timer = entry[2]
+            if timer.owner is owner and timer._live(entry[3]):
+                timer.cancel()
+                n += 1
+        return n
+
     def stop(self) -> None:
         self._stopped = True
 
@@ -135,10 +149,15 @@ class VirtualTimer:
     invokes the cancel handler like asio's operation_aborted path.
     Cancel-and-rearm is safe: heap entries carry the arming generation, so
     a stale entry from before a cancel() can never fire a later callback.
+
+    ``owner`` tags the timer with the object (typically the Application)
+    whose lifetime bounds it, so ``VirtualClock.cancel_owner`` can sweep
+    every timer of one node off a shared simulation clock.
     """
 
-    def __init__(self, clock: VirtualClock):
+    def __init__(self, clock: VirtualClock, owner: object = None):
         self.clock = clock
+        self.owner = owner
         self.cancelled = False
         self._cb: Optional[Callable[[], None]] = None
         self._on_cancel: Optional[Callable[[], None]] = None

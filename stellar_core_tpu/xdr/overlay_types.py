@@ -215,6 +215,10 @@ StellarMessage = Union("StellarMessage", MessageType, {
     MessageType.FLOOD_ADVERT: ("floodAdvert", FloodAdvert),
     MessageType.FLOOD_DEMAND: ("floodDemand", FloodDemand),
 })
+# one flood message encodes (2 + fan-out) times per hop today (MAC
+# verify, floodgate id, then once per forwarded peer); messages are
+# construct-once values, so memoize the encoding on the value
+StellarMessage.memoize = True
 
 _AuthenticatedMessageV0 = Struct("AuthenticatedMessageV0", [
     ("sequence", Uhyper),

@@ -1336,6 +1336,13 @@ SCPEnvelope = Struct("SCPEnvelope", [
     ("statement", SCPStatement),
     ("signature", Signature),
 ])
+# statements/envelopes re-encode constantly on the flood path (MAC per
+# peer send, floodgate dedup id, signature body at every receiving
+# node); both are construct-once values — the single post-construction
+# mutation site (HerderSCPDriver.sign_envelope setting .signature)
+# drops the envelope memo explicitly
+SCPStatement.memoize = True
+SCPEnvelope.memoize = True
 
 SCPQuorumSet = Struct("SCPQuorumSet", [
     ("threshold", Uint),

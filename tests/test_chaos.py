@@ -1,0 +1,269 @@
+"""Chaos engine: deterministic fault injection over simulated networks
+(simulation/chaos.py — ISSUE 7).
+
+Tier-1 scenarios run the standard scripted suite on small core-4
+topologies and assert the full safety contract after every run: zero
+forks among honest survivors (header-chain AND bucket-hash agreement),
+convergence after the faults clear (time-to-heal is finite), and — the
+determinism contract — the same (topology, scenario, seed) reproduces
+identical per-node ledger-hash sequences.  The ``slow`` tier repeats
+the key scenarios at 50-validator tiered/org scale (what
+tools/chaos_bench.py persists as CHAOS_BENCH_r11.json).
+"""
+import random
+
+import pytest
+
+from stellar_core_tpu.overlay.peer import LinkChaos
+from stellar_core_tpu.simulation import core, hierarchical_quorum
+from stellar_core_tpu.simulation.chaos import (
+    STANDARD_SCENARIOS, ChaosEngine, run_scenario, run_standard_scenario)
+
+
+def _core4(tmpdir, **kw):
+    return lambda: core(4, persist_dir=str(tmpdir), MANUAL_CLOSE=False, **kw)
+
+
+def _run(tmpdir, scenario, seed=11, duration=15.0, n=4):
+    return run_standard_scenario(_core4(tmpdir), scenario, seed=seed,
+                                 n_nodes=n, duration=duration)
+
+
+# -- the tier-1 scenario suite (core-4) -------------------------------------
+
+
+def test_partition_heal_no_fork(tmp_path):
+    rep = _run(tmp_path / "a", "partition_heal")
+    assert rep["fork_check"] == "pass"
+    assert rep["counters"]["cut"] > 0, "partition never cut a message"
+    assert rep["time_to_heal_s"] < 60.0
+    assert rep["ledgers_closed"] >= 5
+
+
+def test_crash_restore_mid_close(tmp_path):
+    rep = _run(tmp_path / "a", "crash_restore")
+    assert rep["fork_check"] == "pass"
+    # the restarted node rejoined and externalized the convergence
+    # target (converged() requires ALL honest nodes, crash victim
+    # included, to agree on it)
+    assert rep["time_to_heal_s"] < 60.0
+    assert rep["ledgers_closed"] >= 5
+
+
+def test_equivocator_no_fork(tmp_path):
+    rep = _run(tmp_path / "a", "equivocator", duration=18.0)
+    assert rep["fork_check"] == "pass"
+    assert rep["byzantine"] == 1
+    assert rep["counters"]["equivocations"] > 0, \
+        "equivocator never emitted a conflicting variant"
+    assert rep["time_to_heal_s"] < 60.0
+
+
+def test_stale_replay_discarded(tmp_path):
+    rep = _run(tmp_path / "a", "stale_replay", duration=18.0)
+    assert rep["fork_check"] == "pass"
+    assert rep["counters"]["stale_replayed"] > 0
+    assert rep["counters"]["stale_discarded"] > 0, \
+        "honest nodes never discarded a stale envelope"
+
+
+def test_laggard_recovers(tmp_path):
+    rep = _run(tmp_path / "a", "laggard")
+    assert rep["fork_check"] == "pass"
+    assert rep["counters"]["delayed"] > 0
+    assert rep["time_to_heal_s"] < 60.0
+
+
+def test_flaky_links_counters_surface(tmp_path):
+    rep = _run(tmp_path / "a", "flaky_links", duration=15.0)
+    assert rep["fork_check"] == "pass"
+    c = rep["counters"]
+    assert c["dropped"] + c["damaged"] + c["duplicated"] > 0, \
+        "probabilistic link chaos never fired"
+    assert c["reconnects"] > 0, \
+        "MAC-stream damage should force link re-dials"
+
+
+def test_chaos_seed_determinism(tmp_path):
+    """The contract the whole engine exists for: same (topology,
+    scenario, seed) => identical per-node ledger-hash sequences."""
+    fps = [_run(tmp_path / d, "flaky_links", seed=42, duration=12.0)
+           ["fingerprint"] for d in ("a", "b")]
+    assert fps[0] == fps[1]
+
+
+def test_different_seeds_diverge(tmp_path):
+    """Different chaos seeds must actually produce different runs —
+    otherwise the determinism test above proves nothing."""
+    a = _run(tmp_path / "a", "flaky_links", seed=1, duration=12.0)
+    b = _run(tmp_path / "b", "flaky_links", seed=2, duration=12.0)
+    assert a["fingerprint"] != b["fingerprint"]
+
+
+# -- engine units -----------------------------------------------------------
+
+
+def test_link_chaos_deterministic_faults():
+    """LinkChaos decisions are a pure function of (rng, message seq):
+    two identically-seeded links make identical drop/damage/duplicate
+    choices over any message stream."""
+    outcomes = []
+    for _ in range(2):
+        rng = random.Random(7)
+        chaos = LinkChaos(rng, drop=0.3, damage=0.2, duplicate=0.2)
+        row = []
+        for _ in range(64):
+            if chaos.rng.random() < chaos.drop:
+                row.append("drop")
+            elif chaos.rng.random() < chaos.duplicate:
+                row.append("dup")
+            else:
+                row.append("pass")
+        outcomes.append(row)
+    assert outcomes[0] == outcomes[1]
+    assert "drop" in outcomes[0] and "dup" in outcomes[0]
+
+
+def test_loopback_chaos_counters(tmp_path):
+    """overlay.chaos.* counters tick in /metrics for every injected
+    fault (JSON registry; Prometheus shares the same registry)."""
+    sim = core(2)
+    a, b = list(sim.nodes)
+    app = sim.nodes[a]
+    p1, p2 = sim.link_peers(a, b)
+    # cut: total loss
+    p1.set_chaos(LinkChaos(random.Random(1), cut=True))
+    p1.transport_write(b"\x00" * 8)
+    assert app.metrics.counter("overlay.chaos.cut").count == 1
+    # certain drop
+    p1.set_chaos(LinkChaos(random.Random(1), drop=1.0))
+    p1.transport_write(b"\x00" * 8)
+    assert app.metrics.counter("overlay.chaos.dropped").count == 1
+    # certain duplicate + damage
+    p1.set_chaos(LinkChaos(random.Random(1), duplicate=1.0, damage=1.0))
+    p1.transport_write(b"\x00" * 8)
+    assert app.metrics.counter("overlay.chaos.duplicated").count == 1
+    assert app.metrics.counter("overlay.chaos.damaged").count == 1
+    p1.set_chaos(None)
+    snap = app.metrics.snapshot()
+    assert snap["overlay.chaos.dropped"]["count"] == 1
+
+
+def test_legacy_set_damage_still_works():
+    sim = core(2)
+    a, b = list(sim.nodes)
+    p1, _ = sim.link_peers(a, b)
+    p1.set_damage(drop=1.0, seed=3)
+    p1.transport_write(b"\x00" * 8)
+    assert p1.app.metrics.counter("overlay.chaos.dropped").count == 1
+
+
+def test_partition_is_total_and_heal_restores(tmp_path):
+    """Unit-level: partition() cuts exactly the cross-group links and
+    heal() restores them (no consensus involved)."""
+    sim = core(4)
+    ids = list(sim.nodes)
+    chaos = ChaosEngine(sim, seed=5)
+    chaos.partition([ids[:2], ids[2:]])
+    cut = {k for k, pol in chaos.policies.items() if pol.cut}
+    assert len(cut) == 4  # 2x2 cross links of the full core-4 mesh
+    for (x, y) in cut:
+        assert (x in ids[:2]) != (y in ids[:2])
+    for p in sim.link_peers(*next(iter(cut))):
+        assert p.chaos is not None and p.chaos.cut
+    chaos.heal()
+    assert not any(pol.cut for pol in chaos.policies.values())
+    for p in sim.link_peers(*next(iter(cut))):
+        assert p.chaos is None
+
+
+def test_hierarchical_quorum_topology():
+    """Tiered/org builder: org-majority-of-majorities qset on every
+    node, sparse connectivity (org meshes + leader mesh + backup
+    links) rather than full mesh."""
+    sim = hierarchical_quorum(3, 3)
+    assert len(sim.nodes) == 9
+    app = next(iter(sim.nodes.values()))
+    qs = app.config.QUORUM_SET
+    assert qs["threshold"] == 3 and not qs["validators"]
+    assert len(qs["inner_sets"]) == 3
+    assert all(s["threshold"] == 3 for s in qs["inner_sets"])
+    # 3 orgs x C(3,2) intra + C(3,2) leader links + 3 backup links
+    assert len(sim.topology) == 9 + 3 + 3
+    full_mesh = 9 * 8 // 2
+    assert len(sim.topology) < full_mesh
+
+
+def test_run_scenario_rejects_fork_scripts(tmp_path):
+    """A scenario that permanently halts a quorum can't converge; the
+    runner must fail it loudly rather than report success."""
+    sim_factory = _core4(tmp_path / "a")
+
+    def kill_three(c):
+        for nid in list(c.sim.nodes)[:3]:
+            c.crash(nid)
+            # drop the recipe's node_dir so restore in the epilogue
+            # cannot resurrect them -> convergence must time out
+            c.sim.node_recipes[nid]["node_dir"] = None
+
+    with pytest.raises((AssertionError, Exception)):
+        run_scenario(sim_factory, seed=3,
+                     events=[(2.0, "kill 3 of 4", kill_three)],
+                     duration=6.0, label="kill-quorum",
+                     converge_timeout=10.0)
+
+
+# -- network-scale (slow tier; chaos_bench persists the evidence) -----------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario", ["partition_heal", "equivocator"])
+def test_tiered50_scenarios(tmp_path, scenario):
+    rep = run_standard_scenario(
+        lambda: hierarchical_quorum(10, 5, persist_dir=str(tmp_path),
+                                    MANUAL_CLOSE=False),
+        scenario, seed=11, n_nodes=50, duration=12.0)
+    assert rep["fork_check"] == "pass"
+    assert rep["nodes"] == 50
+    assert rep["fork_comparisons"] > 1000
+    assert rep["time_to_heal_s"] < 90.0
+
+
+@pytest.mark.slow
+def test_tiered50_seed_determinism(tmp_path):
+    fps = []
+    for d in ("a", "b"):
+        rep = run_standard_scenario(
+            lambda: hierarchical_quorum(10, 5,
+                                        persist_dir=str(tmp_path / d),
+                                        MANUAL_CLOSE=False),
+            "crash_restore", seed=11, n_nodes=50, duration=10.0)
+        fps.append(rep["fingerprint"])
+    assert fps[0] == fps[1]
+
+
+def test_standard_scenarios_complete():
+    assert set(STANDARD_SCENARIOS) == {
+        "partition_heal", "crash_restore", "laggard", "flaky_links",
+        "stale_replay", "equivocator"}
+
+
+def test_slot_bracket_uncaps_when_not_tracking():
+    """A node > LEDGER_VALIDITY_BRACKET slots behind must still ingest
+    live traffic once it knows it lost sync (the reference's
+    maxLedgerSeq only caps while TRACKING) — otherwise a long
+    partition/outage wedges it at its stale LCL forever."""
+    from stellar_core_tpu.herder.herder import (
+        LEDGER_VALIDITY_BRACKET, HerderState)
+
+    sim = core(2)
+    sim.start_all_nodes()
+    app = next(iter(sim.nodes.values()))
+    h = app.herder
+    lo, hi = h.scp_slot_bracket()
+    assert hi == app.ledger_manager.last_closed_seq() + \
+        LEDGER_VALIDITY_BRACKET
+    h.state = HerderState.NOT_TRACKING
+    lo2, hi2 = h.scp_slot_bracket()
+    assert lo2 == lo
+    assert hi2 > 2 ** 62

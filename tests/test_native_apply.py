@@ -44,7 +44,7 @@ def test_native_matches_python_across_worker_counts():
     python_fps, python_stats = _run_workload(2, NATIVE_APPLY=False)
     assert python_stats["native_hits"] == 0
     for workers in (2, 4):
-        fps, stats = _run_workload(workers)
+        fps, stats = _run_workload(workers, NATIVE_APPLY=True)
         _assert_identical(python_fps, fps, f"native workers={workers}")
         assert stats["native_hits"] > 0, \
             f"kernel never engaged at workers={workers}: {stats}"
@@ -57,7 +57,8 @@ def test_native_inline_workers0_matches_sequential():
     payment strips without a single thread hop, same bytes."""
     seq, seq_stats = _run_workload(0, n_closes=3)
     assert seq_stats["parallel_closes"] == 0
-    fps, stats = _run_workload(0, n_closes=3, NATIVE_APPLY_INLINE=True)
+    fps, stats = _run_workload(0, n_closes=3, NATIVE_APPLY=True,
+                               NATIVE_APPLY_INLINE=True)
     _assert_identical(seq, fps, "inline native")
     assert stats["parallel_closes"] > 0, stats
     assert stats["native_hits"] > 0, stats
@@ -74,6 +75,11 @@ def test_kill_switch_restores_pure_python_path():
 # -- decline paths -----------------------------------------------------------
 
 def _mk_app(workers, **kw):
+    # these tests are ABOUT the kernel: force it on via config so the
+    # suite stays meaningful (and green) under verify_green's
+    # NATIVE_APPLY=0 fallback-smoke environment — the Python arms
+    # always pass NATIVE_APPLY=False explicitly
+    kw.setdefault("NATIVE_APPLY", True)
     app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), test_config(
         TESTING_UPGRADE_MAX_TX_SET_SIZE=300,
         PARALLEL_APPLY_WORKERS=workers, **kw))
@@ -207,7 +213,8 @@ def test_single_cluster_ring_goes_native_inline():
     planner refusal; with the kernel it becomes a single-cluster native
     plan applied inline on the close thread."""
     seq, _ = _run_workload(0, pattern="ring", n_closes=2)
-    fps, stats = _run_workload(2, pattern="ring", n_closes=2)
+    fps, stats = _run_workload(2, pattern="ring", n_closes=2,
+                               NATIVE_APPLY=True)
     _assert_identical(seq, fps, "ring native")
     assert stats["native_hits"] > 0, stats
 
@@ -387,3 +394,69 @@ def test_detlint_covers_native_apply_and_kernel_handle():
         guarded.add(text.split("=")[0].strip().split(":")[0].strip())
     assert "_applykernel_mod" in guarded, guarded
     assert "_applykernel_tried" in guarded, guarded
+
+
+# -- post-apply invariant pass over kernel deltas (ISSUE 7 satellite) --------
+
+def test_native_invariant_pass_arms_with_checks_configured():
+    """INVARIANT_CHECKS configured (test_config defaults to [".*"])
+    must arm the post-apply cluster-delta pass whenever the kernel can
+    engage; an empty checker list must not (the lazy-decode opt-out)."""
+    app = _mk_app(2)
+    assert app.parallel_apply.native_invariants is True
+    app.graceful_stop()
+    app = _mk_app(2, INVARIANT_CHECKS=[])
+    assert app.parallel_apply.native_invariants is False
+    app.graceful_stop()
+
+
+def test_native_cluster_invariant_violation_aborts_to_python():
+    """A violation seen ONLY at cluster granularity (frame is None —
+    modeling a kernel-side divergence the per-op Python path does not
+    reproduce) must abort the parallel attempt; the sequential replay's
+    bytes win and the close completes bit-identical to forced-Python."""
+    from stellar_core_tpu.invariant.manager import Invariant
+
+    class NativeOnlyTrip(Invariant):
+        NAME = "NativeOnlyTrip"
+
+        def check_on_tx_apply(self, ltx, frame, ok):
+            return "tripped on a kernel delta" if frame is None else ""
+
+    seq, _ = _run_workload(2, n_closes=2, NATIVE_APPLY=False)
+    apps = []
+
+    def arm(app):
+        app.invariants.invariants.append(NativeOnlyTrip())
+        apps.append(app)
+
+    fps, stats = _run_workload(2, n_closes=2, NATIVE_APPLY=True,
+                               app_hook=arm)
+    _assert_identical(seq, fps, "native invariant abort")
+    assert stats["aborts"] > 0, stats
+    assert apps[0].metrics.counter(
+        "apply.native.invariant-fail").count > 0
+
+
+def test_native_invariant_violation_reproduced_crashes_close():
+    """When the sequential replay REPRODUCES the violation it is a real
+    bug, not kernel divergence: the close must crash safety-first."""
+    from stellar_core_tpu.invariant.manager import (
+        Invariant, InvariantDoesNotHold)
+
+    class AlwaysTrip(Invariant):
+        NAME = "AlwaysTrip"
+
+        def check_on_tx_apply(self, ltx, frame, ok):
+            return "always fails"
+
+    app = _mk_app(2)
+    lg = LoadGenerator(app)
+    lg.create_accounts(10)
+    envs = lg.generate_payments(5)
+    for env in envs:
+        assert app.herder.recv_transaction(env) == 0
+    app.invariants.invariants.append(AlwaysTrip())
+    with pytest.raises(InvariantDoesNotHold):
+        app.herder.manual_close()
+    app.graceful_stop()

@@ -47,10 +47,14 @@ def _close_and_fingerprint(app, fps):
 
 
 def _run_workload(workers, seed=7, n_closes=5, txs=80, pattern="pairs",
-                  **kw):
+                  app_hook=None, **kw):
     """Seeded randomized mixed/DEX/conflicting workload through the
-    full node close path; returns (fingerprints, apply stats)."""
+    full node close path; returns (fingerprints, apply stats).
+    ``app_hook(app)`` runs on the started app before any load — the
+    seam for injecting test invariant checkers and the like."""
     app = _mk_app(workers, **kw)
+    if app_hook is not None:
+        app_hook(app)
     lg = LoadGenerator(app)
     lg.payment_pattern = pattern
     lg.create_accounts(40)

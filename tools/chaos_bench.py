@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Chaos scenario bench: the scripted fault suite at network scale.
+
+Runs every standard chaos scenario (simulation/chaos.py) on two tiers —
+a quick fully-connected core-4 and a tiered/org ``hierarchical_quorum``
+network of >= 50 validators — and persists per-scenario evidence to
+``CHAOS_BENCH_r11.json``:
+
+- close latency over the whole hostile run (network-wide externalize
+  spread in virtual ms, wall ms per round, virtual cadence p50/p99),
+- time-to-heal: virtual seconds from the last fault clearing until the
+  LAST honest survivor externalized the convergence target,
+- fault counters (drops/damage/duplicates/cuts/reconnects,
+  equivocations emitted, stale envelopes replayed and discarded),
+- fork check: header-chain AND bucket-hash agreement over every pair of
+  honest survivors (the run aborts on the first divergence),
+- the determinism contract: every scenario re-runs under the SAME chaos
+  seed and must reproduce its fingerprint (one hash over every honest
+  node's (seq, header-hash) externalize sequence) byte-for-byte.
+
+Usage:
+    python -m tools.chaos_bench                 # full suite (~15 min)
+    python -m tools.chaos_bench --tier core4    # quick tier only
+    python -m tools.chaos_bench --scenario partition_heal --tier tiered50
+    python -m tools.chaos_bench --no-rerun      # skip determinism reruns
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stellar_core_tpu.simulation.chaos import (  # noqa: E402
+    STANDARD_SCENARIOS, run_standard_scenario)
+from stellar_core_tpu.simulation.simulation import (  # noqa: E402
+    core, hierarchical_quorum)
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "CHAOS_BENCH_r11.json")
+
+TIERS = {
+    # label -> (factory(persist_dir), n_nodes, scenario duration s)
+    "core4": (lambda d: core(4, persist_dir=d, MANUAL_CLOSE=False), 4, 18.0),
+    "tiered50": (lambda d: hierarchical_quorum(
+        10, 5, persist_dir=d, MANUAL_CLOSE=False), 50, 12.0),
+}
+
+
+def run_one(tier: str, scenario: str, seed: int, rerun: bool) -> dict:
+    factory, n, duration = TIERS[tier]
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory() as d:
+        rep = run_standard_scenario(
+            lambda: factory(d), scenario, seed=seed, n_nodes=n,
+            duration=duration)
+    rep["bench_wall_s"] = round(time.monotonic() - t0, 1)
+    rep["tier"] = tier
+    if rerun:
+        with tempfile.TemporaryDirectory() as d:
+            rep2 = run_standard_scenario(
+                lambda: factory(d), scenario, seed=seed, n_nodes=n,
+                duration=duration)
+        assert rep2["fingerprint"] == rep["fingerprint"], (
+            f"[{tier}/{scenario}] chaos seed {seed} NOT deterministic: "
+            f"{rep['fingerprint']} vs {rep2['fingerprint']}")
+        rep["rerun_identical"] = True
+    del rep["events"]  # scripted, identical across runs; keep JSON lean
+    return rep
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tier", choices=sorted(TIERS), action="append",
+                    help="run only this tier (repeatable; default all)")
+    ap.add_argument("--scenario", choices=STANDARD_SCENARIOS,
+                    action="append",
+                    help="run only this scenario (repeatable; default all)")
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--no-rerun", action="store_true",
+                    help="skip the same-seed determinism rerun")
+    ap.add_argument("--out", default=OUT)
+    args = ap.parse_args()
+
+    tiers = args.tier or sorted(TIERS)
+    scenarios = args.scenario or list(STANDARD_SCENARIOS)
+    results = []
+    for tier in tiers:
+        for scenario in scenarios:
+            print(f"[chaos_bench] {tier}/{scenario} (seed {args.seed}) ...",
+                  flush=True)
+            rep = run_one(tier, scenario, args.seed, not args.no_rerun)
+            results.append(rep)
+            print(f"[chaos_bench]   ledgers={rep['ledgers_closed']} "
+                  f"heal={rep['time_to_heal_s']}s "
+                  f"spread_p99={rep['close_spread_virtual_ms']['p99']}ms "
+                  f"fork={rep['fork_check']} "
+                  f"rerun_identical={rep.get('rerun_identical', 'skipped')} "
+                  f"wall={rep['bench_wall_s']}s", flush=True)
+
+    doc = {
+        "bench": "chaos scenario suite",
+        "seed": args.seed,
+        "tiers": {t: {"nodes": TIERS[t][1], "duration_s": TIERS[t][2]}
+                  for t in tiers},
+        "scenarios": results,
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"[chaos_bench] wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,7 +25,9 @@ Usage: python tools/verify_green.py            -> exit 0 iff green
            slowest tier-1 test FILES (aggregated from pytest's own
            --durations accounting)
        --skip-parallel-smoke / --parallel-smoke-only control the second
-           pass.
+           pass; --skip-chaos-smoke skips the chaos scenario smoke (one
+           core-4 partition+heal run incl. the same-seed determinism
+           rerun, via tools/chaos_bench.py).
 """
 import json
 import os
@@ -141,11 +143,42 @@ def run_parallel_smoke(cmd: str, native: bool = True) -> "tuple":
     return problems, passed, summary
 
 
+def run_chaos_smoke() -> "tuple":
+    """One small chaos scenario end-to-end (core-4 partition+heal, with
+    the same-seed determinism rerun): the full fault-inject -> heal ->
+    no-fork -> bit-identical-fingerprint contract in ~20s.  Returns
+    (problems, summary)."""
+    out = "/tmp/_t1_chaos_smoke.json"
+    cmd = [sys.executable, "-m", "tools.chaos_bench", "--tier", "core4",
+           "--scenario", "partition_heal", "--out", out]
+    print(f"verify_green: [chaos smoke] {' '.join(cmd)}", flush=True)
+    proc = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True)
+    problems = []
+    if proc.returncode != 0:
+        tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-6:])
+        return [f"chaos smoke exited {proc.returncode}: {tail}"], "failed"
+    try:
+        with open(out) as f:
+            rep = json.load(f)["scenarios"][0]
+    except (OSError, ValueError, KeyError, IndexError) as e:
+        return [f"chaos smoke report unreadable: {e}"], "failed"
+    if rep.get("fork_check") != "pass":
+        problems.append("chaos smoke: fork check failed")
+    if rep.get("rerun_identical") is not True:
+        problems.append("chaos smoke: same-seed rerun not bit-identical")
+    summary = (f"{rep.get('ledgers_closed')} ledgers, "
+               f"heal={rep.get('time_to_heal_s')}s, "
+               f"fork={rep.get('fork_check')}, "
+               f"rerun_identical={rep.get('rerun_identical')}")
+    return problems, summary
+
+
 def main() -> int:
     timings = "--timings" in sys.argv
     smoke_only = "--parallel-smoke-only" in sys.argv
     skip_smoke = "--skip-parallel-smoke" in sys.argv
     skip_fallback = "--skip-fallback-smoke" in sys.argv
+    skip_chaos = "--skip-chaos-smoke" in sys.argv
     if smoke_only:
         cmd = tier1_command()
         problems, passed, summary = run_parallel_smoke(cmd)
@@ -214,6 +247,11 @@ def main() -> int:
                   flush=True)
             problems.extend(fb_problems)
             smoke_note += f", fallback smoke passed={fb_passed}"
+    if not skip_chaos:
+        chaos_problems, chaos_summary = run_chaos_smoke()
+        print(f"verify_green: chaos smoke: {chaos_summary}", flush=True)
+        problems.extend(chaos_problems)
+        smoke_note += f", chaos smoke: {chaos_summary}"
     if problems:
         print(f"verify_green: RED ({'; '.join(problems)}); "
               f"passed={passed}", flush=True)
