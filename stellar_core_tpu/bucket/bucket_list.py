@@ -543,15 +543,29 @@ class BucketList:
         try:
             hh = bucket.hash().hex()
         except Exception:
+            from ..utils.logging import get_logger
+
+            # a merge output without a readable hash cannot be released
+            # from GC protection — say so; the entry leaks until restart
+            get_logger("Bucket").warning(
+                "unprotect: merge output %r has no hash; GC protection "
+                "entry retained", bucket)
             return
         with self._bg_lock:
             self._bg_outputs.discard(hh)
 
     def _unprotect_future(self, fut) -> None:
         try:
-            self._unprotect(fut.result())
-        except Exception:
-            pass
+            bucket = fut.result()
+        except Exception as e:
+            from ..utils.logging import get_logger
+
+            # the staged merge failed; the close path notices via its
+            # own sync fallback — here only the GC release is skipped
+            get_logger("Bucket").debug(
+                "unprotect skipped: staged merge failed (%s)", e)
+            return
+        self._unprotect(bucket)
 
     def _merge_dir(self, target_level: int) -> Optional[str]:
         """Directory for the merge result's tier (None = in-memory)."""

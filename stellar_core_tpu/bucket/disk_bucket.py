@@ -304,8 +304,8 @@ class DiskBucket:
         if t is None:
             try:
                 t = _scan_tables(self.path)
-            except Exception:
-                return None
+            except (OSError, RuntimeError):
+                return None  # unreadable/truncated file: Python-tier merge
             _write_sidecar(self.path, *t)
         eoff, elen, types, koff, klen, keys = t
         if len(eoff) != self.count:
@@ -503,6 +503,10 @@ def merge_disk_native(directory: str, newer, older,
 
     os.makedirs(directory, exist_ok=True)
     tmp = os.path.join(directory,
+                       # id() only uniquifies the tmp filename; output
+                       # bytes and hash are key-ordered, the name never
+                       # reaches them
+                       # detlint: allow(det-interproc-taint)
                        f".merge-{os.getpid()}-{id(out_eoff)}.tmp")
     n = lib.bucket_merge_stream(
         pstream(ns), p64(np.ascontiguousarray(ne, np.int64)),

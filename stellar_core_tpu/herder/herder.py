@@ -16,7 +16,7 @@ from ..ledger.ledger_manager import LedgerCloseData
 from ..scp import SCP, EnvelopeState, SCPDriver, ValidationLevel
 from ..scp.local_node import make_qset, qset_hash
 from ..utils.clock import VirtualTimer
-from ..xdr import types as T, xdr_sha256
+from ..xdr import XdrError, types as T, xdr_sha256
 from .tx_queue import TransactionQueue
 from .tx_set import TxSetFrame
 
@@ -95,7 +95,10 @@ class HerderSCPDriver(SCPDriver):
         for v in candidates:
             try:
                 sv = T.StellarValue.decode(v)
-            except Exception:
+            except XdrError:
+                # candidates already passed validate_value; anything but
+                # a typed decode error here is a runtime bug that must
+                # stay loud, not a value to skip silently
                 continue
             ts = self.herder.pending_envelopes.get_tx_set(sv.txSetHash)
             n_ops = ts.size_op() if ts is not None else 0
@@ -235,8 +238,8 @@ def _value_tx_set_hashes(st) -> List[bytes]:
         try:
             sv = T.StellarValue.decode(v)
             out.append(sv.txSetHash)
-        except Exception:
-            pass
+        except XdrError:
+            pass  # malformed value in a peer statement: no tx set to fetch
     return out
 
 
@@ -306,8 +309,8 @@ class Herder:
                 (seq,)).fetchall():
             try:
                 env = T.SCPEnvelope.decode(raw)
-            except Exception:
-                continue
+            except XdrError:
+                continue  # torn row in scphistory: skip, don't wedge restore
             # statement state only — no protocol transitions (tx sets
             # referenced by old envelopes are gone after a restart)
             slot = self.scp.get_slot(env.statement.slotIndex)

@@ -462,6 +462,26 @@ pack_node(int32_t idx, PyObject *v, Out *o)
  *   arm_map: dict {disc: (has_arm, idx)} or None
  *   default_arm: (has_arm, idx) or None
  */
+/* release a partially-built node table (nodes 0..upto inclusive) when
+ * init aborts mid-loop; field name slots are calloc-zeroed, so XDECREF
+ * is safe for the node whose fields were still being filled */
+static void
+free_partial_tab(Node *tab, Py_ssize_t upto)
+{
+    for (Py_ssize_t k = 0; k <= upto; k++) {
+        Node *nd = &tab[k];
+        if (nd->fields) {
+            for (Py_ssize_t j = 0; j < nd->n; j++)
+                Py_XDECREF(nd->fields[j].name);
+            PyMem_Free(nd->fields);
+        }
+        Py_XDECREF(nd->arm_map);
+        Py_XDECREF(nd->valid);
+        Py_XDECREF(nd->memo_key);
+    }
+    PyMem_Free(tab);
+}
+
 static PyObject *
 py_init_schema(PyObject *self, PyObject *args)
 {
@@ -487,6 +507,10 @@ py_init_schema(PyObject *self, PyObject *args)
             Py_ssize_t nf = PyTuple_GET_SIZE(fields);
             nd->n = nf;
             nd->fields = (Field *)PyMem_Calloc(nf, sizeof(Field));
+            if (!nd->fields) {
+                free_partial_tab(tab, i);
+                return PyErr_NoMemory();
+            }
             for (Py_ssize_t j = 0; j < nf; j++) {
                 PyObject *f = PyTuple_GET_ITEM(fields, j);
                 PyObject *nm = PyTuple_GET_ITEM(f, 0);

@@ -100,8 +100,13 @@ def is_quorum(
             q = get_qset(n)
             if q is None:
                 continue
+            # id() is only a memo key; the verdict is a pure function of
+            # the qset VALUE, so which object's id wins a slot never
+            # changes any result
+            # detlint: allow(det-interproc-taint)
             v = verdicts.get(id(q))
             if v is None:
+                # detlint: allow(det-interproc-taint) — same memo key
                 v = verdicts[id(q)] = is_quorum_slice(q, cur)
             if v:
                 nxt.add(n)

@@ -680,6 +680,10 @@ def enable_native_encode(module, build: bool = True) -> bool:
     global _native_pack
     if _native_pack is not None:
         return True
+    # vars() order is module definition order (same every process);
+    # node indices are process-local and wire bytes are canonical by
+    # construction
+    # detlint: allow(det-unsorted-iter)
     roots = [t for t in vars(module).values() if isinstance(t, XdrType)]
     try:
         _compile_native_schema(roots, build)

@@ -305,3 +305,668 @@ def test_repo_lock_annotations_are_honoured():
     findings = [f for f in lint_repo()
                 if f.rule.startswith("lock-")]
     assert not findings, [f.render() for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# detlint v2: interprocedural determinism taint (ISSUE 9 tentpole)
+# ---------------------------------------------------------------------------
+
+SCP_HELPER = "stellar_core_tpu/scp/injected_helpers.py"
+SCP_SINK = "stellar_core_tpu/scp/injected_sink.py"
+KERNEL = "stellar_core_tpu/native/apply_kernel.cpp"
+
+
+def _kernel_source():
+    with open(f"{REPO}/{KERNEL}", encoding="utf-8") as fh:
+        return fh.read()
+
+
+def test_interproc_taint_through_helper_is_caught_with_chain():
+    """The acceptance shape: an unsorted-iteration helper WITHOUT a
+    sink (invisible to every v1 rule) feeding a hash through one
+    intermediate call in another module of scp/."""
+    helper = '''
+def collect(envelopes):
+    out = []
+    for node, env in envelopes.items():
+        out.append(env)
+    return out
+'''
+    sink = '''
+from .injected_helpers import collect
+
+
+def vote_hash(envelopes):
+    import hashlib
+    h = hashlib.sha256()
+    for env in collect(envelopes):
+        h.update(env)
+    return h.digest()
+'''
+    # v1 alone is blind: the helper has no sink, the sink fn has no
+    # unsorted iteration
+    v1 = [f for f in lint_sources({SCP_HELPER: helper})
+          if f.rule != "det-interproc-taint"]
+    assert not v1, [f.render() for f in v1]
+
+    findings = lint_sources({SCP_HELPER: helper, SCP_SINK: sink})
+    hits = [f for f in findings if f.rule == "det-interproc-taint"]
+    assert hits, [f.render() for f in findings]
+    f = hits[0]
+    assert f.file == SCP_SINK and f.context == "vote_hash"
+    # the full source->sink chain is in the message
+    assert "vote_hash -> collect" in f.message
+    assert "unsorted .items() iteration" in f.message
+    assert "injected_helpers.py:4" in f.message
+    # ...and it is unbaselined (strict goes red)
+    fresh, _, _ = match_baseline(findings, load_baseline())
+    assert any(x.rule == "det-interproc-taint" for x in fresh)
+
+
+def test_interproc_wallclock_chain_across_two_hops():
+    helper = '''
+import time
+
+
+def jitter():
+    return time.time() % 1.0
+
+
+def mix(values):
+    return [v + jitter() for v in values]
+'''
+    sink = '''
+from .injected_helpers import mix
+
+
+def emit(values, driver):
+    driver.emit_envelope(mix(values))
+'''
+    findings = lint_sources({SCP_HELPER: helper, SCP_SINK: sink})
+    hits = [f for f in findings if f.rule == "det-interproc-taint"]
+    assert hits, [f.render() for f in findings]
+    assert "emit -> mix -> jitter" in hits[0].message
+    assert "wallclock time.time()" in hits[0].message
+
+
+def test_interproc_source_pragma_kills_all_chains():
+    helper = '''
+import time
+
+
+def jitter():
+    # detlint: allow(det-wallclock)
+    return time.time() % 1.0
+'''
+    sink = '''
+from .injected_helpers import jitter
+
+
+def emit(values, driver):
+    driver.emit_envelope([jitter() for _ in values])
+'''
+    findings = [f for f in lint_sources({SCP_HELPER: helper,
+                                         SCP_SINK: sink})
+                if f.rule == "det-interproc-taint"]
+    assert not findings, [f.render() for f in findings]
+
+
+def test_interproc_sink_pragma_and_baseline_round_trip():
+    helper = '''
+import time
+
+
+def jitter():
+    return time.time() % 1.0
+'''
+    sink = '''
+from .injected_helpers import jitter
+
+
+def emit(values, driver):
+    # detlint: allow(det-interproc-taint)
+    driver.emit_envelope([jitter() for _ in values])
+'''
+    taint = [f for f in lint_sources({SCP_HELPER: helper,
+                                      SCP_SINK: sink})
+             if f.rule == "det-interproc-taint"]
+    assert not taint
+    # baseline round-trip: the same finding pinned by identity
+    sink_nopragma = sink.replace(
+        "    # detlint: allow(det-interproc-taint)\n", "")
+    findings = lint_sources({SCP_HELPER: helper, SCP_SINK: sink_nopragma})
+    taint = [f for f in findings if f.rule == "det-interproc-taint"]
+    assert taint
+    entry = {"rule": taint[0].rule, "file": taint[0].file,
+             "context": taint[0].context,
+             "line_text": taint[0].line_text, "justification": "test"}
+    fresh, pinned, stale = match_baseline(taint, [entry])
+    assert not fresh and pinned and not stale
+
+
+def test_interproc_id_source_and_sanctioned_modules():
+    helper = '''
+def cache_key(obj):
+    return id(obj)
+'''
+    sink = '''
+from .injected_helpers import cache_key
+
+
+def digest(objs):
+    import hashlib
+    return hashlib.sha256(bytes(cache_key(o) % 256 for o in objs)).digest()
+'''
+    findings = [f for f in lint_sources({SCP_HELPER: helper,
+                                         SCP_SINK: sink})
+                if f.rule == "det-interproc-taint"]
+    assert findings and "id id()" in findings[0].message
+    # sanctioned module: the same source in utils/tracing.py is exempt
+    from tools.lint.callgraph import SANCTIONED_MODULES
+
+    assert "stellar_core_tpu/utils/tracing.py" in SANCTIONED_MODULES
+
+
+def test_interproc_depth_bound_is_enforced():
+    """A chain longer than MAX_TAINT_DEPTH edges does not propagate —
+    the documented blind spot, pinned so it changes consciously."""
+    from tools.lint.callgraph import MAX_TAINT_DEPTH
+
+    hops = MAX_TAINT_DEPTH + 1
+    parts = ["import time", "", "",
+             "def h0():", "    return time.time()", ""]
+    for i in range(1, hops):
+        parts += [f"def h{i}():", f"    return h{i - 1}()", ""]
+    parts += ["def over(driver):",
+              f"    driver.emit_envelope(h{hops - 1}())"]
+    src = "\n".join(parts)
+    findings = [f for f in lint_sources({SCP_HELPER: src})
+                if f.rule == "det-interproc-taint"]
+    assert not findings, [f.render() for f in findings]
+    # one hop fewer: caught
+    src_ok = src.replace(f"emit_envelope(h{hops - 1}())",
+                         f"emit_envelope(h{hops - 2}())")
+    findings = [f for f in lint_sources({SCP_HELPER: src_ok})
+                if f.rule == "det-interproc-taint"]
+    assert findings
+
+
+# ---------------------------------------------------------------------------
+# detlint v2: native-kernel auditor
+# ---------------------------------------------------------------------------
+
+def test_injected_constant_drift_is_caught():
+    """Acceptance: a one-character drift in apply_kernel.cpp fails the
+    gate (neither present in the shipped tree)."""
+    drifted = _kernel_source().replace("MAX_OFFERS_TO_CROSS = 1000",
+                                       "MAX_OFFERS_TO_CROSS = 1001")
+    findings = lint_sources({KERNEL: drifted})
+    hits = [f for f in findings if f.rule == "native-lockstep"]
+    assert hits, [f.render() for f in findings]
+    assert "max-offers-to-cross" in hits[0].message
+    assert "1001 != 1000" in hits[0].message
+    fresh, _, _ = match_baseline(findings, load_baseline())
+    assert any(f.rule == "native-lockstep" for f in fresh)
+
+
+def test_python_side_constant_drift_is_caught():
+    """The same entry fails when the PYTHON twin drifts instead."""
+    path = "stellar_core_tpu/transactions/utils.py"
+    with open(f"{REPO}/{path}", encoding="utf-8") as fh:
+        src = fh.read()
+    drifted = src.replace("MAX_OFFERS_TO_CROSS = 1000",
+                          "MAX_OFFERS_TO_CROSS = 999")
+    findings = [f for f in lint_sources({path: drifted})
+                if f.rule == "native-lockstep"]
+    assert findings, "python-side drift must fail the gate"
+    assert any("999 != 1000" in f.message and f.file == path
+               for f in findings)
+
+
+def test_stale_lockstep_manifest_pattern_is_itself_a_finding():
+    renamed = _kernel_source().replace("MAX_OFFERS_TO_CROSS",
+                                       "MAX_OFFERS_CROSSED")
+    findings = [f for f in lint_sources({KERNEL: renamed})
+                if f.rule == "native-lockstep"]
+    assert any("no longer matches" in f.message for f in findings)
+
+
+def test_injected_py_call_inside_allow_threads_is_caught():
+    """Acceptance: Py* under Py_BEGIN_ALLOW_THREADS fails the gate."""
+    bad = _kernel_source().replace(
+        "    try {\n        for (auto &kv : c.store)",
+        "    PyErr_Clear();\n    try {\n        for (auto &kv : c.store)")
+    findings = [f for f in lint_sources({KERNEL: bad})
+                if f.rule == "native-gil-api"]
+    assert findings, "Py* in an allow-threads region must be caught"
+    assert "PyErr_Clear" in findings[0].message
+    # ...and a // pragma suppresses a justified one
+    ok = _kernel_source().replace(
+        "    try {\n        for (auto &kv : c.store)",
+        "    PyErr_Clear(); // detlint: allow(native-gil-api)\n"
+        "    try {\n        for (auto &kv : c.store)")
+    findings = [f for f in lint_sources({KERNEL: ok})
+                if f.rule == "native-gil-api"]
+    assert not findings, [f.render() for f in findings]
+
+
+def test_block_threads_window_is_exempt():
+    bad = _kernel_source().replace(
+        "    try {\n        for (auto &kv : c.store)",
+        "    Py_BLOCK_THREADS;\n    PyErr_Clear();\n"
+        "    Py_UNBLOCK_THREADS;\n"
+        "    try {\n        for (auto &kv : c.store)")
+    findings = [f for f in lint_sources({KERNEL: bad})
+                if f.rule == "native-gil-api"]
+    assert not findings, [f.render() for f in findings]
+
+
+def test_unchecked_allocator_is_caught_and_checked_is_not():
+    bad = _kernel_source().replace(
+        "    PyObject *deltas = PyList_New((Py_ssize_t)delta_keys.size());\n"
+        "    if (!deltas)\n        return NULL;",
+        "    PyObject *deltas = PyList_New((Py_ssize_t)delta_keys.size());")
+    findings = [f for f in lint_sources({KERNEL: bad})
+                if f.rule == "native-null-unchecked"]
+    assert findings, "removing the NULL check must surface a finding"
+    assert "deltas" in findings[0].message
+    # the shipped kernel (checks intact) is clean
+    clean = [f for f in lint_sources({KERNEL: _kernel_source()})
+             if f.rule == "native-null-unchecked"]
+    assert not clean, [f.render() for f in clean]
+
+
+def test_comments_naming_py_functions_do_not_trip_the_auditor():
+    src = '''
+#include <Python.h>
+/* PyBytes_AsStringAndSize would segfault on NULL — see glue below */
+static PyObject *f(PyObject *s, PyObject *a) {
+    Py_BEGIN_ALLOW_THREADS;
+    // PyErr_SetString is NOT legal here
+    int x = 1;
+    Py_END_ALLOW_THREADS;
+    return NULL;
+}
+'''
+    findings = [f for f in lint_sources(
+        {"stellar_core_tpu/native/injected.cpp": src})
+        if f.rule in ("native-gil-api", "native-null-unchecked")]
+    assert not findings, [f.render() for f in findings]
+
+
+def test_srchash_sidecar_audit(tmp_path):
+    from tools.lint.native import SO_SOURCES, check_srchash
+
+    ndir = tmp_path / "stellar_core_tpu" / "native"
+    ndir.mkdir(parents=True)
+    for srcs in SO_SOURCES.values():
+        for s in srcs:
+            (ndir / s).write_text("int x;\n")
+    (ndir / "_xdrpack.so").write_bytes(b"\x7fELF-fake")
+    # missing sidecar -> finding
+    findings = check_srchash(str(tmp_path))
+    assert any(f.rule == "native-srchash" and "missing" in f.message
+               for f in findings)
+    # stale sidecar -> finding
+    (ndir / "_xdrpack.so.srchash").write_text("0" * 64)
+    findings = check_srchash(str(tmp_path))
+    assert any(f.rule == "native-srchash" and "stale" in f.message
+               for f in findings)
+    # current sidecar -> clean
+    import hashlib
+    h = hashlib.sha256()
+    h.update((ndir / "xdr_pack.c").read_bytes())
+    (ndir / "_xdrpack.so.srchash").write_text(h.hexdigest())
+    findings = check_srchash(str(tmp_path))
+    assert not findings, [f.render() for f in findings]
+    # unknown .so -> finding (no auditable contract)
+    (ndir / "_mystery.so").write_bytes(b"??")
+    findings = check_srchash(str(tmp_path))
+    assert any("unknown native library" in f.message for f in findings)
+
+
+def test_shipped_tree_sidecars_are_current():
+    from tools.lint.native import check_srchash
+
+    findings = check_srchash(REPO)
+    assert not findings, [f.render() for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# detlint v2: exception-safety & resource rules
+# ---------------------------------------------------------------------------
+
+def test_swallow_except_rules():
+    src = '''
+def bare(raw):
+    try:
+        return decode(raw)
+    except:
+        return None
+
+
+def silent(raw):
+    try:
+        return decode(raw)
+    except Exception:
+        pass
+
+
+def acts(raw):
+    try:
+        return decode(raw)
+    except Exception:
+        log.warning("bad value")
+        return None
+
+
+def narrow(raw):
+    try:
+        return decode(raw)
+    except ValueError:
+        pass
+'''
+    findings = lint_sources({TALLY: src})
+    assert {(f.rule, f.context) for f in findings} == {
+        ("safety-swallow-except", "bare"),
+        ("safety-swallow-except", "silent"),
+    }, [f.render() for f in findings]
+    # pragma round-trip
+    ok = src.replace("    except:",
+                     "    # detlint: allow(safety-swallow-except)\n"
+                     "    except:").replace(
+        "    except Exception:\n        pass",
+        "    # detlint: allow(safety-swallow-except)\n"
+        "    except Exception:\n        pass", 1)
+    assert not lint_sources({TALLY: ok}), \
+        [f.render() for f in lint_sources({TALLY: ok})]
+
+
+def test_resource_ctx_rule():
+    src = '''
+import os
+
+
+def good(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def bad(path):
+    f = open(path, "rb")
+    data = f.read()
+    f.close()
+    return data
+
+
+class Cache:
+    def keeps(self, path):
+        fd = os.open(path, os.O_RDONLY)
+        self._fd = fd
+        return fd
+'''
+    findings = lint_sources({BUCKET: src})
+    assert {(f.rule, f.context) for f in findings} == {
+        ("safety-resource-ctx", "bad"),
+    }, [f.render() for f in findings]
+
+
+def test_mutable_default_rule():
+    src = '''
+def tally(votes, seen=set()):
+    return votes
+
+
+def fine(votes, seen=None):
+    return votes
+'''
+    findings = lint_sources({TALLY: src})
+    assert [f.rule for f in findings] == ["safety-mutable-default"]
+    assert findings[0].context == "tally"
+
+
+# ---------------------------------------------------------------------------
+# detlint v2: --changed incremental mode
+# ---------------------------------------------------------------------------
+
+def test_changed_mode_reuses_cache_and_matches_cold_run(tmp_path):
+    from tools.lint.cache import lint_changed
+
+    pkg = tmp_path / "stellar_core_tpu" / "scp"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "x.py").write_text(
+        "import time\n\n\ndef stamp():\n    return time.time()\n")
+    cpath = str(tmp_path / "cache.json")
+    f1, s1 = lint_changed(root=str(tmp_path), path=cpath)
+    assert s1["reused"] == 0 and len(s1["changed"]) == 2
+    f2, s2 = lint_changed(root=str(tmp_path), path=cpath)
+    assert not s2["changed"] and s2["reused"] == 2
+    # warm run is finding-identical to the cold run
+    assert [f.render() for f in f1] == [f.render() for f in f2]
+    assert any(f.rule == "det-wallclock" for f in f2)
+    # edit the file: only it re-analyzes, the finding goes away
+    (pkg / "x.py").write_text("def stamp(clock):\n    return clock.now()\n")
+    f3, s3 = lint_changed(root=str(tmp_path), path=cpath)
+    assert s3["changed"] == ["stellar_core_tpu/scp/x.py"]
+    assert not any(f.rule == "det-wallclock" for f in f3)
+
+
+def test_changed_mode_on_repo_matches_full_run(tmp_path):
+    """--changed against the real tree reports exactly what the cold
+    full run reports (zero, per the gate) — strict on --changed is
+    sound."""
+    from tools.lint.cache import lint_changed
+
+    cpath = str(tmp_path / "cache.json")
+    cold, _ = lint_changed(root=REPO, path=cpath)
+    warm, stats = lint_changed(root=REPO, path=cpath)
+    assert not stats["changed"]
+    assert [f.render() for f in cold] == [f.render() for f in warm]
+    full = lint_repo()
+    assert [f.render() for f in full] == [f.render() for f in cold]
+
+
+def test_verify_green_lint_only_gate():
+    proc = subprocess.run(
+        [sys.executable, "tools/verify_green.py", "--lint-only"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "LINT GREEN" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# regressions for what the v2 first full run surfaced and this PR fixed
+# ---------------------------------------------------------------------------
+
+def test_value_tx_set_hashes_skips_malformed_but_propagates_bugs():
+    """safety-swallow-except fix in herder.py: the decode guard eats
+    XdrError (hostile/torn peer bytes) but no longer masks runtime
+    bugs behind 'except Exception'."""
+    from stellar_core_tpu.herder import herder as H
+    from stellar_core_tpu.scp import statement as S
+    from stellar_core_tpu.xdr import XdrError, types as T
+
+    class FakeStatement:
+        pass
+
+    st = FakeStatement()
+    orig_pt, orig_nv = S.pledge_type, S.nomination_values
+    S.pledge_type = lambda s: S.ST_NOMINATE
+    S.nomination_values = lambda s: [b"\x00garbage-not-xdr"]
+    try:
+        assert H._value_tx_set_hashes(st) == []
+        # a NON-decode error must stay loud
+        orig_decode = T.StellarValue.decode
+        T.StellarValue.decode = staticmethod(
+            lambda v: (_ for _ in ()).throw(RuntimeError("driver bug")))
+        try:
+            import pytest
+            with pytest.raises(RuntimeError):
+                H._value_tx_set_hashes(st)
+        finally:
+            T.StellarValue.decode = orig_decode
+    finally:
+        S.pledge_type, S.nomination_values = orig_pt, orig_nv
+    assert issubclass(XdrError, Exception)
+
+
+def test_unprotect_future_logs_instead_of_silent_swallow(caplog):
+    """safety-swallow-except fix in bucket_list.py: a failed staged
+    merge no longer disappears without a trace at GC-unprotect time."""
+    import logging
+    import threading
+    from concurrent.futures import Future
+
+    from stellar_core_tpu.bucket.bucket_list import BucketList
+
+    bl = object.__new__(BucketList)
+    bl._bg_lock = threading.Lock()
+    bl._bg_outputs = {"aa"}
+    fut = Future()
+    fut.set_exception(RuntimeError("merge exploded"))
+    with caplog.at_level(logging.DEBUG,
+                         logger="stellar_core_tpu.Bucket"):
+        bl._unprotect_future(fut)  # must not raise
+    assert any("staged merge failed" in r.message for r in caplog.records)
+    assert bl._bg_outputs == {"aa"}  # protection entry intact
+
+    class BadBucket:
+        def hash(self):
+            raise RuntimeError("no hash")
+
+        def __repr__(self):
+            return "<BadBucket>"
+
+    with caplog.at_level(logging.WARNING,
+                         logger="stellar_core_tpu.Bucket"):
+        bl._unprotect(BadBucket())  # must not raise
+    assert any("has no hash" in r.message for r in caplog.records)
+
+
+def test_merge_table_narrowed_guard(tmp_path, monkeypatch):
+    """safety-swallow-except fix in disk_bucket.py: unreadable files
+    still fall back to the Python tier; unexpected error types
+    propagate instead of being silently converted into a fallback."""
+    import pytest
+
+    from stellar_core_tpu.bucket import disk_bucket as DB
+
+    b = object.__new__(DB.DiskBucket)
+    b.path = str(tmp_path / "nope.bucket")
+    b.size_bytes = 123
+    b.count = 1
+    monkeypatch.setattr(DB, "_read_sidecar", lambda *a, **k: None)
+    monkeypatch.setattr(DB, "_scan_tables",
+                        lambda p: (_ for _ in ()).throw(OSError("gone")))
+    assert b.merge_table() is None
+    monkeypatch.setattr(DB, "_scan_tables",
+                        lambda p: (_ for _ in ()).throw(TypeError("bug")))
+    with pytest.raises(TypeError):
+        b.merge_table()
+
+
+# ---------------------------------------------------------------------------
+# review-pass regressions: gate soundness of the cold run and the cache
+# ---------------------------------------------------------------------------
+
+def test_unparseable_file_goes_red_in_cold_run():
+    """A SyntaxError'd consensus file must be a finding, not silence —
+    the cold full run (the CI gate) and --changed agree on the verdict."""
+    findings = lint_sources(
+        {"stellar_core_tpu/scp/broken.py": "def f(:\n    pass\n"})
+    assert [f.rule for f in findings] == ["parse-error"]
+
+
+def test_cache_invalidated_when_lint_rules_change(tmp_path):
+    """Cached findings were computed BY the rule sources — a cache
+    stamped by different tools must be dropped wholesale, or --changed
+    --strict could stay green where a cold run goes red."""
+    import json
+
+    from tools.lint.cache import lint_changed
+
+    pkg = tmp_path / "stellar_core_tpu" / "scp"
+    pkg.mkdir(parents=True)
+    (pkg / "x.py").write_text("import time\n\n\ndef s():\n"
+                              "    return time.time()\n")
+    cpath = tmp_path / "cache.json"
+    _, s1 = lint_changed(root=str(tmp_path), path=str(cpath))
+    assert s1["reused"] == 0
+    _, s2 = lint_changed(root=str(tmp_path), path=str(cpath))
+    assert s2["reused"] == 1
+    # simulate a pulled commit that changed a rule module: the recorded
+    # tools fingerprint no longer matches
+    data = json.loads(cpath.read_text())
+    data["tools_sha256"] = "0" * 64
+    cpath.write_text(json.dumps(data))
+    _, s3 = lint_changed(root=str(tmp_path), path=str(cpath))
+    assert s3["reused"] == 0, "stale-rules cache must be dropped"
+
+
+def test_srchash_reverse_audit_catches_stale_source_map(tmp_path):
+    from tools.lint.native import SO_SOURCES, check_srchash
+
+    ndir = tmp_path / "stellar_core_tpu" / "native"
+    ndir.mkdir(parents=True)
+    for srcs in SO_SOURCES.values():
+        for s in srcs:
+            (ndir / s).write_text("int x;\n")
+    assert not check_srchash(str(tmp_path))
+    (ndir / "apply_kernel.cpp").unlink()
+    findings = check_srchash(str(tmp_path))
+    assert any("missing source apply_kernel.cpp" in f.message
+               for f in findings)
+
+
+def test_changed_mode_parity_on_a_tree_with_findings(tmp_path):
+    """Cache/cold parity proven on a tree that actually HAS findings of
+    several families (per-file, interproc, native, srchash) — a cache
+    path that drops findings cannot pass this."""
+    from tools.lint.cache import lint_changed
+    from tools.lint.engine import lint_repo as cold_run
+
+    pkg = tmp_path / "stellar_core_tpu"
+    (pkg / "scp").mkdir(parents=True)
+    (pkg / "native").mkdir()
+    (pkg / "scp" / "helpers.py").write_text(
+        "def collect(envelopes):\n"
+        "    out = []\n"
+        "    for node, env in envelopes.items():\n"
+        "        out.append(env)\n"
+        "    return out\n")
+    (pkg / "scp" / "sink.py").write_text(
+        "import time\n\n"
+        "from .helpers import collect\n\n\n"
+        "def vote_hash(envelopes, h):\n"
+        "    try:\n"
+        "        for env in collect(envelopes):\n"
+        "            h.update(env)\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "    return h.digest()\n\n\n"
+        "def stamp():\n"
+        "    return time.time()\n")
+    (pkg / "native" / "injected.cpp").write_text(
+        "#include <Python.h>\n"
+        "static PyObject *f(PyObject *s) {\n"
+        "    Py_BEGIN_ALLOW_THREADS;\n"
+        "    PyErr_Clear();\n"
+        "    Py_END_ALLOW_THREADS;\n"
+        "    return NULL;\n"
+        "}\n")
+    (pkg / "native" / "_xdrpack.so").write_bytes(b"fake")  # no sidecar
+
+    cold = cold_run(root=str(tmp_path))
+    warm_cold, _ = lint_changed(root=str(tmp_path),
+                                path=str(tmp_path / "c.json"))
+    warm, stats = lint_changed(root=str(tmp_path),
+                               path=str(tmp_path / "c.json"))
+    assert not stats["changed"], "second run must be all cache hits"
+    rules = {f.rule for f in cold}
+    assert {"det-interproc-taint", "safety-swallow-except",
+            "det-wallclock", "native-gil-api",
+            "native-srchash"} <= rules, sorted(rules)
+    assert [f.render() for f in cold] \
+        == [f.render() for f in warm_cold] \
+        == [f.render() for f in warm]

@@ -29,6 +29,12 @@ def main(argv=None) -> int:
                          "whole stellar_core_tpu package)")
     ap.add_argument("--strict", action="store_true",
                     help="exit 1 on any unbaselined finding")
+    ap.add_argument("--changed", action="store_true",
+                    help="incremental run: reuse .detlint-cache.json "
+                         "for files whose content hash is unchanged, "
+                         "re-analyze the rest, recompute the global "
+                         "passes — full-run-identical findings in "
+                         "dev-loop time")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
     ap.add_argument("--baseline", default=BASELINE_PATH,
@@ -43,12 +49,25 @@ def main(argv=None) -> int:
               "scoped run would truncate the baseline to the given "
               "paths' findings", file=sys.stderr)
         return 2
+    if args.changed and args.paths:
+        print("detlint: --changed and explicit paths are mutually "
+              "exclusive (--changed scopes itself by content hash)",
+              file=sys.stderr)
+        return 2
     if args.paths:
         try:
             findings = lint_paths(args.paths, args.root)
         except FileNotFoundError as e:
             print(f"detlint: {e}", file=sys.stderr)
             return 2
+    elif args.changed:
+        from .cache import lint_changed
+
+        findings, stats = lint_changed(args.root)
+        if not args.as_json:
+            print(f"detlint: --changed re-analyzed "
+                  f"{len(stats['changed'])} files, reused "
+                  f"{stats['reused']} cached")
     else:
         findings = lint_repo(args.root)
     baseline = load_baseline(args.baseline)

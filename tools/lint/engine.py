@@ -160,35 +160,106 @@ def _parse_file(relpath: str, source: str) -> Optional[FileInfo]:
     return info
 
 
+#: native kernel sources audited by tools/lint/native.py
+NATIVE_EXTS = (".cpp", ".c")
+
+
 def discover_files(root: str = REPO) -> List[str]:
-    """Repo-relative paths of every package .py file under analysis."""
+    """Repo-relative paths of every package .py file under analysis,
+    plus the native kernel sources (.cpp/.c) the native auditor lexes."""
     out: List[str] = []
     pkg_root = os.path.join(root, PACKAGE)
     for dirpath, dirnames, filenames in os.walk(pkg_root):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
         for name in sorted(filenames):
-            if name.endswith(".py"):
+            if name.endswith(".py") or name.endswith(NATIVE_EXTS):
                 full = os.path.join(dirpath, name)
                 out.append(os.path.relpath(full, root))
     return sorted(out)
 
 
-def lint_sources(sources: Dict[str, str]) -> List[Finding]:
+def light_info(relpath: str, source: str) -> FileInfo:
+    """A tree-less FileInfo carrying only lines + pragmas — enough for
+    line_text rendering and suppression of findings that land in a file
+    the current run did not (re)parse (the --changed cache path)."""
+    info = FileInfo(path=relpath.replace(os.sep, "/"), source=source,
+                    tree=None, lines=source.splitlines())
+    _scan_comments(info)
+    return info
+
+
+def _lockstep_involved(paths: Iterable[str]) -> bool:
+    """Should a scoped run diff the lockstep manifest?  Yes whenever any
+    kernel source or any Python twin named in the manifest is in scope
+    (missing counterparts are read from disk)."""
+    from .native import MANIFEST_PATH, load_manifest
+
+    try:
+        manifest = load_manifest(MANIFEST_PATH)
+    except (OSError, ValueError, KeyError, TypeError):
+        # unreadable manifest: RUN the pass so check_lockstep reports
+        # the broken manifest — never degrade to silence
+        return True
+    involved = set()
+    for e in manifest:
+        involved.add(e["cpp"]["file"])
+        if e.get("py"):
+            involved.add(e["py"]["file"])
+    return bool(involved & set(paths))
+
+
+def check_py_file(info: FileInfo) -> List[Finding]:
+    """Every rule family computable from ONE parsed .py file — the
+    single dispatch list shared by the cold run (lint_sources) and the
+    --changed cache (cache.py), so a new per-file rule module cannot be
+    added to one path and silently missed by the other."""
+    from . import determinism, locks, safety
+
+    findings = determinism.check(info)
+    findings.extend(safety.check(info))
+    findings.extend(locks.check([info]))
+    return findings
+
+
+def lint_sources(sources: Dict[str, str],
+                 root: Optional[str] = None) -> List[Finding]:
     """Analyze {repo-relative-path: source}; the seam tests use to lint
-    injected/mutated source without touching the working tree."""
-    from . import determinism, locks
+    injected/mutated source without touching the working tree.  .py
+    files run the AST rule families (determinism, safety, locks) plus
+    the whole-program interprocedural taint pass; .cpp/.c files run the
+    native auditor.  ``root`` (set by lint_paths/lint_repo) additionally
+    enables the filesystem-backed srchash sidecar audit."""
+    from . import interproc, native
 
     infos: List[FileInfo] = []
+    native_infos: List["native.NativeInfo"] = []
+    findings: List[Finding] = []
     for relpath, source in sorted(sources.items()):
+        if relpath.endswith(NATIVE_EXTS):
+            native_infos.append(native.parse_native(relpath, source))
+            continue
         info = _parse_file(relpath, source)
         if info is not None:
             infos.append(info)
-    findings: List[Finding] = []
+        else:
+            # an unparseable file must go RED, never read as clean —
+            # same verdict the --changed path gives (cache.py)
+            findings.append(Finding(
+                rule="parse-error", file=relpath.replace(os.sep, "/"),
+                line=1, col=0, context="<module>",
+                message="file does not parse — fix before linting",
+                line_text=""))
     for info in infos:
-        findings.extend(determinism.check(info))
-    findings.extend(locks.check(infos))
+        findings.extend(check_py_file(info))
+    findings.extend(interproc.check(infos))
+    findings.extend(native.check(
+        native_infos,
+        py_sources={i.path: i.source for i in infos},
+        root=root,
+        run_lockstep=bool(native_infos) or _lockstep_involved(sources)))
+    by_path: Dict[str, object] = {i.path: i for i in infos}
+    by_path.update({i.path: i for i in native_infos})
     out = []
-    by_path = {i.path: i for i in infos}
     for f in findings:
         info = by_path.get(f.file)
         if info is not None and _suppressed(info, f):
@@ -213,7 +284,7 @@ def lint_paths(relpaths: Iterable[str], root: str = REPO) -> List[Finding]:
     if missing:
         raise FileNotFoundError(
             f"cannot read: {', '.join(missing)}")
-    return lint_sources(sources)
+    return lint_sources(sources, root=root)
 
 
 def lint_repo(root: str = REPO) -> List[Finding]:

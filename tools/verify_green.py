@@ -24,6 +24,10 @@ Usage: python tools/verify_green.py            -> exit 0 iff green
        python tools/verify_green.py --timings  -> also print the 10
            slowest tier-1 test FILES (aggregated from pytest's own
            --durations accounting)
+       python tools/verify_green.py --lint-only -> CI-style fast gate:
+           ONLY detlint v2 --strict (determinism + interprocedural
+           taint with source->sink chains + native-kernel auditor +
+           safety rules), no pytest; exit code is the lint verdict.
        --skip-parallel-smoke / --parallel-smoke-only control the second
            pass; --skip-chaos-smoke skips the chaos scenario smoke (one
            core-4 partition+heal run incl. the same-seed determinism
@@ -175,6 +179,18 @@ def run_chaos_smoke() -> "tuple":
 
 def main() -> int:
     timings = "--timings" in sys.argv
+    if "--lint-only" in sys.argv:
+        # the fast CI gate: the native auditor + interprocedural taint
+        # pass (with source->sink chains in every finding) run inside
+        # the same strict lint; a red exit here is a LINT RED verdict
+        lint_rc = run_detlint()
+        if lint_rc != 0:
+            print(f"verify_green: LINT RED (detlint --strict exited "
+                  f"{lint_rc})", flush=True)
+            return 1
+        print("verify_green: LINT GREEN (detlint --strict clean)",
+              flush=True)
+        return 0
     smoke_only = "--parallel-smoke-only" in sys.argv
     skip_smoke = "--skip-parallel-smoke" in sys.argv
     skip_fallback = "--skip-fallback-smoke" in sys.argv
