@@ -176,7 +176,7 @@ class ClusterResult:
 
     __slots__ = ("cluster_id", "records", "delta", "okeys", "vkeys",
                  "header", "op_costs", "span_seconds", "encode_seconds",
-                 "native")
+                 "native", "batched")
 
     def __init__(self, cluster_id: int):
         self.cluster_id = cluster_id
@@ -193,6 +193,9 @@ class ClusterResult:
         # "decline:<reason>" (kernel refused, Python applied), or None
         # (kernel never attempted)
         self.native: Optional[str] = None
+        # applied as part of a multi-cluster batched kernel crossing
+        # (ROADMAP 2d amortized dispatch)
+        self.batched = False
 
 
 class ParallelApplyManager:
@@ -260,6 +263,7 @@ class ParallelApplyManager:
             "native_hits": 0,      # clusters applied by the kernel
             "native_declines": 0,  # kernel refused -> Python fallback
             "native_off": 0,       # clusters never offered to the kernel
+            "batched_clusters": 0,  # kernel hits via batched crossings
             "escapes": [],  # last few escape reasons, newest last
             "native_decline_reasons": [],  # newest last, bounded
         }
@@ -452,6 +456,9 @@ class ParallelApplyManager:
             if res.native == "hit":
                 self.stats["native_hits"] += 1
                 metrics.counter("apply.native.hit").inc()
+                if res.batched:
+                    self.stats["batched_clusters"] += 1
+                    metrics.counter("apply.native.batched_clusters").inc()
             elif res.native is not None:
                 self.stats["native_declines"] += 1
                 metrics.counter("apply.native.decline").inc()
@@ -482,12 +489,80 @@ class ParallelApplyManager:
     def _run_task(self, clusters, snapshot, apply_order, verify,
                   invariant_check, abort, tracer,
                   parent_token) -> List["ClusterResult"]:
-        """Worker-side: one task runs its packed clusters back to back
-        (each against its own view + LedgerTxn)."""
-        return [self._run_cluster(cluster, snapshot, apply_order, verify,
-                                  invariant_check, abort, tracer,
-                                  parent_token)
-                for cluster in clusters]
+        """Worker-side: one task runs its packed clusters back to back.
+
+        Runs of kernel-eligible, non-id-pool clusters are coalesced
+        into ONE batched kernel crossing (one encode, one GIL release)
+        instead of one call per 2-tx cluster — the amortized-dispatch
+        half of ROADMAP 2d.  Everything else goes through the
+        per-cluster path unchanged."""
+        results: List["ClusterResult"] = []
+        batch: List = []
+
+        def run_one(cluster):
+            return self._run_cluster(cluster, snapshot, apply_order,
+                                     verify, invariant_check, abort,
+                                     tracer, parent_token)
+
+        def flush():
+            if len(batch) >= 2:
+                results.extend(self._run_cluster_batch(
+                    list(batch), snapshot, apply_order, verify,
+                    invariant_check, abort, tracer, parent_token))
+            elif batch:
+                results.append(run_one(batch[0]))
+            batch.clear()
+
+        for cluster in clusters:
+            if self.native_wanted and cluster.kernel_ok and \
+                    not cluster.writes_header:
+                batch.append(cluster)
+            else:
+                flush()
+                results.append(run_one(cluster))
+        flush()
+        return results
+
+    def _run_cluster_batch(self, batch, snapshot, apply_order, verify,
+                           invariant_check, abort, tracer,
+                           parent_token) -> List["ClusterResult"]:
+        """One GIL-released kernel crossing for a run of disjoint
+        kernel-eligible clusters; on any decline, retry per cluster so
+        one poisoned cluster cannot drag its batchmates off the kernel."""
+        from .native_apply import (
+            KernelDecline, run_clusters_native_batched)
+
+        if abort.is_set():
+            raise FootprintEscape("aborted by another cluster")
+        total_txs = sum(len(c.indices) for c in batch)
+        with tracer.span("ledger.apply.cluster.native.batch",
+                         parent=parent_token, clusters=len(batch),
+                         txs=total_txs, outcome="hit") as nspan:
+            try:
+                batch_results = run_clusters_native_batched(
+                    batch, snapshot, apply_order, verify, ClusterResult)
+            except KernelDecline as e:
+                if nspan.args is not None:
+                    nspan.args["outcome"] = "decline"
+                    nspan.args["reason"] = str(e)
+                batch_results = None
+        if batch_results is None:
+            return [self._run_cluster(c, snapshot, apply_order, verify,
+                                      invariant_check, abort, tracer,
+                                      parent_token)
+                    for c in batch]
+        ordered = sorted(batch, key=lambda c: c.cluster_id)
+        for cluster, res in zip(ordered, batch_results):
+            if self.native_invariants:
+                self._check_native_invariants(cluster, snapshot, res)
+            # metrics attribution only: apportion the crossing's wall
+            # time across its clusters by tx count
+            share = nspan.seconds * len(cluster.indices) / total_txs
+            res.op_costs = {"native_kernel": [share,
+                                              len(cluster.indices)]}
+            res.span_seconds = share
+            res.batched = True
+        return batch_results
 
     def _run_cluster(self, cluster, snapshot,
                      apply_order, verify, invariant_check, abort,

@@ -137,32 +137,29 @@ def _tx_tuple(frame, shape) -> tuple:
             shape[5])
 
 
-def run_cluster_native(cluster, snapshot, apply_order, verify,
-                       result_cls):
-    """Apply one kernel-eligible cluster natively.
-
-    Returns a populated ``result_cls`` (the executor's ClusterResult)
-    or raises ``KernelDecline`` — the caller then runs the Python
-    reference apply for the cluster.  Never mutates shared state: the
-    kernel works on copies, so a decline discards everything.
-    """
-    from ..utils import tracing
-
+def _kernel_ready(snapshot):
+    """Shared dispatch gates; returns the kernel module or raises."""
     mod = kernel_module()
     if mod is None:
         raise KernelDecline("kernel unavailable")
     if not _constants_in_lockstep():
         raise KernelDecline("protocol constant drift")
-
-    header = snapshot.header
-    if header.ledgerVersion != 19:
+    if snapshot.header.ledgerVersion != 19:
         # the kernel mirrors protocol-19 semantics; older gated
         # behaviors (check order, liability rules) stay host-side
         raise KernelDecline(
-            f"protocol version {header.ledgerVersion} not kernel-backed")
+            f"protocol version {snapshot.header.ledgerVersion} "
+            f"not kernel-backed")
+    return mod
+
+
+def _screen_cluster(cluster, snapshot, apply_order, verify):
+    """Host-side per-tx gates (shape, clean master-key signature,
+    supported account shapes) — cheap, run BEFORE any encoding is
+    paid.  Returns the cluster's frames."""
     frames = [apply_order[i] for i in cluster.indices]
-    shapes = list(cluster.shapes)
-    for idx, frame, shape in zip(cluster.indices, frames, shapes):
+    for idx, frame, shape in zip(cluster.indices, frames,
+                                 cluster.shapes):
         if shape is None:
             raise KernelDecline(f"tx {idx} not kernel-shaped")
         if not _signature_ok(frame, verify):
@@ -172,21 +169,70 @@ def run_cluster_native(cluster, snapshot, apply_order, verify,
         _screen_account(snapshot, frame.source_account_id(), idx)
         if shape[0] == "payment":
             _screen_account(snapshot, shape[1], idx)
+    return frames
 
-    params = (header.ledgerSeq, header.scpValue.closeTime, header.baseFee,
-              header.baseReserve, snapshot.idpool0)
+
+def _pack_inputs(snapshot, keys, pairs):
+    """(entries, books) kernel tables over a declared key/pair set."""
     entries = []
-    for kb in sorted(cluster.keys):
+    for kb in sorted(keys):
         e = snapshot.store[kb]
         entries.append((kb, None if e is None else T.LedgerEntry.encode(e)))
     books = []
-    for pair in sorted(cluster.pairs):
+    for pair in sorted(pairs):
         directions = snapshot.books[pair]
         for direction in sorted(directions):
             books.append((direction[0], direction[1],
                           [kb for _, _, kb in directions[direction]]))
+    return entries, books
+
+
+def _fill_records(res, indices, frames, records) -> None:
+    """Wrap kernel (meta, result) byte pairs into the ClusterResult
+    record shape the merge/hash/commit phases consume."""
+    from ..utils import tracing
+
+    inner_union = T.TransactionResult.fields[1][1]
+    ext_v0 = T.TransactionResult.fields[2][1].make(0)
+    with tracing.stopwatch() as sw:
+        for idx, frame, (meta_b, result_b) in zip(indices, frames,
+                                                  records):
+            pair_b = frame.full_hash() + result_b
+            env_b = T.TransactionEnvelope.encode(frame.envelope)
+            # TransactionResult is a struct: rebuild its cheap scalar
+            # fields eagerly (feeCharged i64 leads the encoding, ext v0
+            # trails) and keep only the result union lazy
+            result = T.TransactionResult.make(
+                feeCharged=frame.fee_charged,
+                result=LazyUnion(inner_union, result_b[8:-4]),
+                ext=ext_v0)
+            res.records[idx] = (
+                True,
+                result,
+                LazyUnion(T.TransactionMeta, meta_b),
+                meta_b, pair_b, env_b,
+            )
+    res.encode_seconds += sw.seconds
+
+
+def run_cluster_native(cluster, snapshot, apply_order, verify,
+                       result_cls):
+    """Apply one kernel-eligible cluster natively.
+
+    Returns a populated ``result_cls`` (the executor's ClusterResult)
+    or raises ``KernelDecline`` — the caller then runs the Python
+    reference apply for the cluster.  Never mutates shared state: the
+    kernel works on copies, so a decline discards everything.
+    """
+    mod = _kernel_ready(snapshot)
+    header = snapshot.header
+    frames = _screen_cluster(cluster, snapshot, apply_order, verify)
+
+    params = (header.ledgerSeq, header.scpValue.closeTime, header.baseFee,
+              header.baseReserve, snapshot.idpool0)
+    entries, books = _pack_inputs(snapshot, cluster.keys, cluster.pairs)
     txs = [_tx_tuple(frame, shape)
-           for frame, shape in zip(frames, shapes)]
+           for frame, shape in zip(frames, cluster.shapes)]
 
     out = mod.apply_cluster(params, entries, books, txs)
     if not out[0]:
@@ -212,25 +258,82 @@ def run_cluster_native(cluster, snapshot, apply_order, verify,
         if not cluster.writes_header:
             raise KernelDecline("kernel allocated ids without the token")
         res.header = header._replace(idPool=idpool_final)
-    inner_union = T.TransactionResult.fields[1][1]
-    ext_v0 = T.TransactionResult.fields[2][1].make(0)
-    with tracing.stopwatch() as sw:
-        for idx, frame, (meta_b, result_b) in zip(cluster.indices, frames,
-                                                  records):
-            pair_b = frame.full_hash() + result_b
-            env_b = T.TransactionEnvelope.encode(frame.envelope)
-            # TransactionResult is a struct: rebuild its cheap scalar
-            # fields eagerly (feeCharged i64 leads the encoding, ext v0
-            # trails) and keep only the result union lazy
-            result = T.TransactionResult.make(
-                feeCharged=frame.fee_charged,
-                result=LazyUnion(inner_union, result_b[8:-4]),
-                ext=ext_v0)
-            res.records[idx] = (
-                True,
-                result,
-                LazyUnion(T.TransactionMeta, meta_b),
-                meta_b, pair_b, env_b,
-            )
-    res.encode_seconds = sw.seconds
+    _fill_records(res, cluster.indices, frames, records)
     return res
+
+
+def run_clusters_native_batched(clusters, snapshot, apply_order, verify,
+                                result_cls):
+    """Apply MANY kernel-eligible clusters in ONE encode + ONE
+    GIL-released ``apply_cluster`` crossing (ROADMAP 2d: a 1000-payment
+    close plans hundreds of 2-tx clusters, and per-cluster dispatch
+    pays the FFI/encode toll hundreds of times).
+
+    Sound because batchable clusters are disjoint by construction (the
+    planner merges any key/book/id-pool conflict into one cluster) and
+    none writes the header (id-pool allocators are excluded by the
+    caller): applying their transactions back-to-back over the merged
+    snapshot table is exactly per-cluster application.  Outputs are
+    split back per cluster — deltas by the declared-key ownership map,
+    records by tx index.  Any decline rejects the WHOLE batch; the
+    caller retries per cluster so one poisoned cluster cannot drag its
+    batchmates onto the Python path.
+    """
+    mod = _kernel_ready(snapshot)
+    header = snapshot.header
+    clusters = sorted(clusters, key=lambda c: c.cluster_id)
+    owner: dict = {}
+    all_keys: set = set()
+    all_pairs: set = set()
+    txs = []
+    frames_of = {}
+    for cluster in clusters:
+        if cluster.writes_header:
+            raise KernelDecline(
+                f"cluster {cluster.cluster_id} allocates offer ids; "
+                f"not batchable")
+        frames = _screen_cluster(cluster, snapshot, apply_order, verify)
+        frames_of[cluster.cluster_id] = frames
+        for kb in cluster.keys:
+            owner[kb] = cluster
+        all_keys |= cluster.keys
+        all_pairs |= cluster.pairs
+        for frame, shape in zip(frames, cluster.shapes):
+            txs.append(_tx_tuple(frame, shape))
+
+    params = (header.ledgerSeq, header.scpValue.closeTime, header.baseFee,
+              header.baseReserve, snapshot.idpool0)
+    entries, books = _pack_inputs(snapshot, all_keys, all_pairs)
+    out = mod.apply_cluster(params, entries, books, txs)
+    if not out[0]:
+        _, reason, tx_index = out
+        raise KernelDecline(
+            f"kernel declined batched tx {tx_index}: {reason}")
+    _, deltas, records, idpool_final = out
+    if idpool_final != snapshot.idpool0:
+        raise KernelDecline("batched kernel allocated offer ids")
+
+    results = {}
+    for c in clusters:
+        res = result_cls(c.cluster_id)
+        res.native = "hit"
+        results[c.cluster_id] = res
+    for kb, eb in deltas:
+        cluster = owner.get(kb)
+        # no fresh-offer exemption here: id-pool allocators never batch,
+        # so every write must belong to exactly one declared key set
+        if cluster is None or kb not in cluster.writes:
+            raise KernelDecline(
+                f"batched kernel wrote undeclared key {kb.hex()}")
+        res = results[cluster.cluster_id]
+        res.delta[kb] = None if eb is None else PackedEntry(eb)
+        if kb.startswith(_OFFER_PREFIX):
+            res.okeys.add(kb)
+    pos = 0
+    for cluster in clusters:
+        frames = frames_of[cluster.cluster_id]
+        n = len(frames)
+        _fill_records(results[cluster.cluster_id], cluster.indices,
+                      frames, records[pos:pos + n])
+        pos += n
+    return [results[c.cluster_id] for c in clusters]

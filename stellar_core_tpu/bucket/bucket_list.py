@@ -647,14 +647,43 @@ class BucketList:
         the levels once with the whole probe set (the prefetch feed for
         LedgerTxnRoot; ref BucketListDB bulk load + the native
         bucket_lower_bound batch kernel)."""
+        return self._get_entries_walk(
+            list(self._buckets_shallow_first()), kbs, self.stats,
+            self.index_enabled)
+
+    def snapshot_read_buckets(self) -> list:
+        """Stable bucket list for an off-thread batched lookup
+        (close-pipeline footprint prefetch): bucket objects are
+        immutable, only the LEVEL SLOTS mutate at add_batch — so a
+        caller on the main thread snapshots the slots (indexes built
+        here, not on the worker) and the worker walks the snapshot."""
+        buckets = list(self._buckets_shallow_first())
+        if self.index_enabled:
+            for bucket in buckets:
+                bucket.ensure_index()
+        return buckets
+
+    def get_entries_from(self, buckets: list, kbs
+                         ) -> Dict[bytes, Optional[object]]:
+        """``get_entries`` over a pre-snapshotted bucket list, with
+        thread-local stats (worker-safe: never touches the live level
+        slots or the shared stats dict)."""
+        local = {"point_reads": 0, "bucket_probes": 0, "bloom_checks": 0,
+                 "bloom_false_positives": 0}
+        return self._get_entries_walk(buckets, kbs, local,
+                                      self.index_enabled)
+
+    @staticmethod
+    def _get_entries_walk(buckets: list, kbs, st: Dict[str, float],
+                          index_enabled: bool
+                          ) -> Dict[bytes, Optional[object]]:
         pending = list(dict.fromkeys(kbs))
         out: Dict[bytes, Optional[object]] = {}
-        st = self.stats
         st["point_reads"] += len(pending)
-        for bucket in self._buckets_shallow_first():
+        for bucket in buckets:
             if not pending:
                 break
-            if self.index_enabled:
+            if index_enabled:
                 idx = bucket.ensure_index()
                 if idx is None:
                     continue
@@ -673,7 +702,7 @@ class BucketList:
             hits = set()
             for kb, e in zip(candidates, found):
                 if e is None:
-                    if self.index_enabled:
+                    if index_enabled:
                         st["bloom_false_positives"] += 1
                     continue
                 out[kb] = (None if e.type == BET.DEADENTRY else e.value)
@@ -826,12 +855,21 @@ class BucketManager:
             import os
 
             os.makedirs(bucket_dir, exist_ok=True)
-        self._saved: set = set()
+        # store bookkeeping below is shared between the close thread
+        # (_persist_new_buckets after every add_batch) and the close
+        # pipeline's tail worker (gc_unreferenced): the lock serializes
+        # the exists-check/rename of adoption against GC's deletions,
+        # so a spill re-producing a previously-collected content hash
+        # can never lose its file to a concurrently-firing delete
+        import threading as _threading
+
+        self._gc_lock = _threading.Lock()
+        self._saved: set = set()        # guarded-by: _gc_lock
         # two-pass GC tombstones: a file is only deleted after TWO
         # consecutive passes see it unreferenced, so a background merge
         # renaming its output between the dir scan and the futures check
         # can never lose the file it just wrote
-        self._gc_candidates: set = set()
+        self._gc_candidates: set = set()  # guarded-by: _gc_lock
 
     def _attach_tracer(self) -> None:
         """Point the (possibly just-swapped) bucket list at the owning
@@ -870,29 +908,39 @@ class BucketManager:
 
         from .disk_bucket import DiskBucket
 
-        for lv in self.bucket_list.levels:
-            for b in (lv.curr, lv.snap):
-                hh = b.hash().hex()
-                if hh == "00" * 32 or hh in self._saved:
-                    continue
-                if isinstance(b, DiskBucket):
-                    # already a content-addressed file in the store
+        with self._gc_lock:
+            # serialized against gc_unreferenced's delete loop: if GC
+            # collected this hash earlier, it also dropped it from
+            # _saved, so the file is simply rewritten here
+            for lv in self.bucket_list.levels:
+                for b in (lv.curr, lv.snap):
+                    hh = b.hash().hex()
+                    if hh == "00" * 32 or hh in self._saved:
+                        continue
+                    if isinstance(b, DiskBucket):
+                        # already a content-addressed file in the store
+                        self._saved.add(hh)
+                        continue
+                    path = self._bucket_path(hh)
+                    if not os.path.exists(path):
+                        tmp = path + ".tmp"
+                        with open(tmp, "wb") as f:
+                            f.write(b.serialize())
+                        os.replace(tmp, path)
                     self._saved.add(hh)
-                    continue
-                path = self._bucket_path(hh)
-                if not os.path.exists(path):
-                    tmp = path + ".tmp"
-                    with open(tmp, "wb") as f:
-                        f.write(b.serialize())
-                    os.replace(tmp, path)
-                self._saved.add(hh)
 
-    def gc_unreferenced(self) -> None:
+    def gc_unreferenced(self, extra_live=None) -> None:
         """Delete bucket files the current (durably committed) bucket list
         no longer references (ref forgetUnreferencedBuckets).  Completed
         background-merge outputs awaiting adoption are protected, and
         deletion is two-pass (see _gc_candidates) so an in-flight worker
-        renaming its output concurrently can never race a delete."""
+        renaming its output concurrently can never race a delete.
+
+        ``extra_live``: additional hex hashes to protect — the pipelined
+        close's tail passes the level-hash snapshot it just persisted,
+        so nothing the DURABLE state references is ever collected even
+        if the next close's spills already replaced it in the live
+        list."""
         import os
 
         if self.bucket_dir is None:
@@ -901,6 +949,8 @@ class BucketManager:
                 for lv in self.bucket_list.levels
                 for b in (lv.curr, lv.snap)}
         live |= self.bucket_list.pending_merge_hashes()
+        if extra_live:
+            live |= set(extra_live)
         # scan the directory (not just _saved): background merges write
         # content-addressed files that may never be adopted (discarded
         # futures, restarts) and would otherwise leak forever
@@ -925,15 +975,30 @@ class BucketManager:
         # ....idx.<pid>.tmp) — reap only when that pid is gone, so an
         # in-flight worker of a live process is never raced
         self._reap_dead_tmp(names)
-        for name in candidates & self._gc_candidates:
-            if name.endswith(".xdr"):
-                self._saved.discard(name[len("bucket-"):-len(".xdr")])
-            for victim in (name, name + ".idx"):
-                try:
-                    os.remove(os.path.join(self.bucket_dir, victim))
-                except OSError:
-                    pass
-        self._gc_candidates = candidates - self._gc_candidates
+        with self._gc_lock:
+            # re-check liveness at delete time: a spill on the close
+            # thread may have re-produced one of these content hashes
+            # since the scan above.  Together with the lock (adoption's
+            # exists-check/skip in _persist_new_buckets serializes
+            # against this loop, and a file deleted here is simply
+            # re-written there because its hash left _saved) no
+            # interleaving can lose a live bucket's file.
+            live_now = {b.hash().hex()
+                        for lv in self.bucket_list.levels
+                        for b in (lv.curr, lv.snap)}
+            live_now |= self.bucket_list.pending_merge_hashes()
+            for name in candidates & self._gc_candidates:
+                if name.endswith(".xdr"):
+                    hh = name[len("bucket-"):-len(".xdr")]
+                    if hh in live_now:
+                        continue
+                    self._saved.discard(hh)
+                for victim in (name, name + ".idx"):
+                    try:
+                        os.remove(os.path.join(self.bucket_dir, victim))
+                    except OSError:
+                        pass
+            self._gc_candidates = candidates - self._gc_candidates
 
     @staticmethod
     def _tmp_owner_pid(name: str):
@@ -981,8 +1046,9 @@ class BucketManager:
                                "DISK_BUCKET_LEVEL", None))
         self.bucket_list.executor = self.executor
         self._attach_tracer()
-        self._saved = {hh for pair in level_hashes for hh in pair
-                       if hh != "00" * 32}
+        with self._gc_lock:
+            self._saved = {hh for pair in level_hashes for hh in pair
+                           if hh != "00" * 32}
 
     def assume_bucket_list(self, bucket_list: BucketList) -> None:
         """Adopt a bucket list built by catchup; persist its buckets and

@@ -6,7 +6,9 @@ registry like the reference's mQueryMeter/timers)."""
 from __future__ import annotations
 
 import sqlite3
+import threading
 import time
+from contextlib import contextmanager
 from typing import Optional
 
 from ..ledger.ledger_txn import SCHEMA
@@ -18,7 +20,13 @@ class Database:
     def __init__(self, path: str = ":memory:", metrics=None,
                  slow_query_seconds: float = 0.25):
         self.path = path
-        self.conn = sqlite3.connect(path)
+        # check_same_thread=False: the pipelined close commits ledger
+        # N's tail from a dedicated worker while the main thread reads
+        # (and SQLite's serialized mode makes each call safe).  Commit
+        # boundaries are serialized via _write_lock so no thread can
+        # commit another's half-written transaction.
+        self.conn = sqlite3.connect(path, check_same_thread=False)
+        self._write_lock = threading.RLock()
         # sqlite's compiled-statement cache IS the prepared-statement
         # cache seam (ref Database::getPreparedStatement)
         self.conn.execute(f"PRAGMA cache_size=-{4096}")
@@ -37,14 +45,22 @@ class Database:
     def execute(self, sql: str, params=()) -> sqlite3.Cursor:
         t0 = time.perf_counter()
         try:
-            return self.conn.execute(sql, params)
+            if sql.lstrip()[:6].upper() == "SELECT":
+                # reads run lock-free: sqlite's serialized mode makes
+                # the call itself safe, and reads never trigger the
+                # sqlite3 module's implicit BEGIN (whose not-thread-
+                # aware bookkeeping is why writes must serialize)
+                return self.conn.execute(sql, params)
+            with self._write_lock:
+                return self.conn.execute(sql, params)
         finally:
             self._account(sql, time.perf_counter() - t0)
 
     def executemany(self, sql: str, seq) -> sqlite3.Cursor:
         t0 = time.perf_counter()
         try:
-            return self.conn.executemany(sql, seq)
+            with self._write_lock:
+                return self.conn.executemany(sql, seq)
         finally:
             self._account(sql, time.perf_counter() - t0)
 
@@ -52,7 +68,26 @@ class Database:
         return self.conn.cursor()
 
     def commit(self) -> None:
-        self.conn.commit()
+        with self._write_lock:
+            self.conn.commit()
+
+    @contextmanager
+    def write_txn(self):
+        """Exclusive multi-statement transaction scope: holds the write
+        lock so no other thread's ``commit`` can land mid-sequence, and
+        rolls the connection back if the body raises (a failed
+        pipelined tail must not leave half a close for the next commit
+        to flush).  The body calls ``commit()`` itself — the lock is
+        re-entrant."""
+        with self._write_lock:
+            try:
+                yield self.conn
+            except BaseException:
+                try:
+                    self.conn.rollback()
+                except sqlite3.Error:
+                    pass  # connection already closed/poisoned
+                raise
 
     def close(self) -> None:
         self.conn.close()

@@ -271,6 +271,13 @@ class Herder:
         self.tracking_timer = VirtualTimer(app.clock, owner=app)
         self.out_of_sync_timer = VirtualTimer(app.clock, owner=app)
         self.lost_sync_count = 0
+        # slots the persisted SCP history shows EXTERNALIZED beyond the
+        # durable LCL (a crash between SCP persistence and the ledger
+        # commit — e.g. inside the pipelined close's tail window): the
+        # restored protocol state is already terminal, so SCP will
+        # never re-announce them; the herder replays the close itself
+        # once the value's tx set is fetched from a peer
+        self._restored_externalized: Dict[int, bytes] = {}
 
     @staticmethod
     def _build_qset(cfg):
@@ -304,6 +311,9 @@ class Herder:
         if not row or row[0] is None:
             return
         seq = row[0]
+        from ..scp.statement import ST_EXTERNALIZE, pledge_type
+
+        lcl = self.app.ledger_manager.last_closed_seq()
         for (raw,) in self.app.database.execute(
                 "SELECT envelope FROM scphistory WHERE ledgerseq=?",
                 (seq,)).fetchall():
@@ -313,8 +323,19 @@ class Herder:
                 continue  # torn row in scphistory: skip, don't wedge restore
             # statement state only — no protocol transitions (tx sets
             # referenced by old envelopes are gone after a restart)
-            slot = self.scp.get_slot(env.statement.slotIndex)
+            st = env.statement
+            slot = self.scp.get_slot(st.slotIndex)
             slot.set_state_from_envelope(env)
+            # SCP history commits at externalize, BEFORE the ledger's
+            # durable commit — a crash in between (the pipelined tail
+            # window) restores a slot whose protocol state is terminal
+            # while the ledger never applied it.  Remember the value:
+            # recv_tx_set replays the close once a peer supplies the
+            # tx set (the slot's own SCP machine stays silent forever)
+            if st.slotIndex > lcl and \
+                    pledge_type(st) == ST_EXTERNALIZE:
+                self._restored_externalized.setdefault(
+                    st.slotIndex, st.pledges.value.commit.value)
 
     def _arm_trigger(self) -> None:
         cfg = self.app.config
@@ -462,6 +483,37 @@ class Herder:
 
     def recv_tx_set(self, tx_set: TxSetFrame) -> None:
         self.pending_envelopes.add_tx_set(tx_set)
+        self._maybe_replay_restored_externalize()
+
+    def _maybe_replay_restored_externalize(self) -> None:
+        """Close a slot the persisted SCP history already externalized
+        but the ledger never durably applied (crash inside the
+        pipelined close's seal-to-commit window): the restored SCP
+        state is terminal and never re-announces, so once the tx set
+        arrives from a peer the herder replays the externalization
+        itself."""
+        lm = self.app.ledger_manager
+        slot = lm.last_closed_seq() + 1
+        # anything at or below the LCL got applied after all
+        for s in [s for s in self._restored_externalized if s < slot]:
+            del self._restored_externalized[s]
+        value = self._restored_externalized.get(slot)
+        if value is None:
+            return
+        try:
+            sv = T.StellarValue.decode(value)
+        except XdrError:
+            del self._restored_externalized[slot]
+            return
+        if self.pending_envelopes.get_tx_set(sv.txSetHash) is None:
+            return
+        del self._restored_externalized[slot]
+        from ..utils.logging import get_logger
+
+        get_logger("Herder").info(
+            "replaying restored externalized slot %d (crash between "
+            "SCP persistence and ledger commit)", slot)
+        self.value_externalized(slot, value)
 
     def recv_qset(self, qset) -> None:
         self.pending_envelopes.add_qset(qset)
@@ -482,12 +534,19 @@ class Herder:
 
         with self.app.tracer.span("herder.trigger.txset", slot=slot):
             frames = self.tx_queue.get_transactions()
+            # exact-key footprint prefetch (ledger/close_pipeline.py):
+            # a worker batch-loads the candidates' declared LedgerKey
+            # sets from the bucket tier WHILE this thread builds the
+            # proposal; adopted below, so the preplan's sponsor reads
+            # and the close's prefetch phase hit a warm cache
+            prefetch = lm.pipeline.stage_prefetch(frames, lm.root)
             tx_set = TxSetFrame.make_from_transactions(
                 self.app.config.network_id(), lcl_hash, frames, lm.root,
                 max_tx_set_size or lcl_header.maxTxSetSize,
                 lcl_header.baseFee,
                 max_dex_ops=self.app.config.MAX_DEX_TX_OPERATIONS)
             self.pending_envelopes.add_tx_set(tx_set)
+            lm.pipeline.adopt_prefetch(prefetch, lm.root)
             # plan the parallel apply of our own proposal NOW, off the
             # close's critical path; the close consumes the cached plan
             # when this exact set externalizes (apply/executor.py)
@@ -600,18 +659,23 @@ class Herder:
 
     def _persist_scp_history(self, slot_index: int) -> None:
         """Persist the slot's SCP envelopes for audit + history publish
-        (ref HerderPersistenceImpl::saveSCPHistory)."""
+        (ref HerderPersistenceImpl::saveSCPHistory).  The whole batch
+        runs under the database's write-transaction scope: per-statement
+        locking alone would let the close pipeline's tail transaction
+        interleave between rows on the shared connection — its commit
+        would absorb (or its rollback discard) half a slot's history."""
         slot = self.scp.slots.get(slot_index)
         if slot is None:
             return
         db = self.app.database
-        for env in slot.latest_envelopes():
-            db.execute(
-                "INSERT INTO scphistory(nodeid, ledgerseq, envelope) "
-                "VALUES(?,?,?)",
-                (env.statement.nodeID.value, slot_index,
-                 T.SCPEnvelope.encode(env)))
-        db.commit()
+        with db.write_txn():
+            for env in slot.latest_envelopes():
+                db.execute(
+                    "INSERT INTO scphistory(nodeid, ledgerseq, envelope) "
+                    "VALUES(?,?,?)",
+                    (env.statement.nodeID.value, slot_index,
+                     T.SCPEnvelope.encode(env)))
+            db.commit()
 
     # -- manual close (test/standalone) -------------------------------------
 

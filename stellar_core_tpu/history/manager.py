@@ -90,26 +90,36 @@ class HistoryManager:
     # -- close-path hooks (ref maybeQueueHistoryCheckpoint /
     # publishQueuedHistory, called from closeLedger) -------------------------
 
-    def maybe_queue_history_checkpoint(self, seq: int) -> None:
+    def maybe_queue_history_checkpoint(self, seq: int, level_hashes=None,
+                                       buckets=None) -> None:
         """Queue entries snapshot the bucket-list level hashes AT the
         checkpoint ledger — a crash-delayed republish must not stamp the
         HAS with whatever the bucket list looks like later (the archived
         header's bucketListHash would never match and minimal catchup to
         that checkpoint would be permanently broken).  The referenced
         buckets are pinned in memory until published (ref
-        PublishQueueBuckets retaining files via refcounts)."""
+        PublishQueueBuckets retaining files via refcounts).
+
+        The pipelined close tail passes ``level_hashes``/``buckets``
+        snapshots captured at seal: by the time the tail runs, the NEXT
+        close may already be mutating the live level list."""
         if not self.archives or self.suppress_publish:
             return
         if self.is_last_ledger_in_checkpoint(seq):
             q = self._load_queue()
             if not any(e[0] == seq for e in q):
-                hashes = self.app.bucket_manager.bucket_list.level_hashes()
-                q.append([seq, hashes])
+                if level_hashes is None:
+                    level_hashes = \
+                        self.app.bucket_manager.bucket_list.level_hashes()
+                q.append([seq, level_hashes])
                 self._store_queue(q)
-                for lv in self.app.bucket_manager.bucket_list.levels:
-                    for b in (lv.curr, lv.snap):
-                        if not b.is_empty():
-                            self._pinned[b.hash().hex()] = b
+                if buckets is None:
+                    buckets = [
+                        b for lv in
+                        self.app.bucket_manager.bucket_list.levels
+                        for b in (lv.curr, lv.snap) if not b.is_empty()]
+                for b in buckets:
+                    self._pinned[b.hash().hex()] = b
 
     def publish_queued_history(self) -> None:
         """Run a PublishWork per queued checkpoint.  The queue is a

@@ -41,6 +41,17 @@ class LedgerManager:
             bucket_list=lambda: app.bucket_manager.bucket_list)
         self._lcl_hash: Optional[bytes] = None
         self.metrics = app.metrics
+        # pipelined close engine (ledger/close_pipeline.py): after the
+        # header seals, the commit/meta/gc tail runs on a worker while
+        # the herder triggers the next ledger; PIPELINED_CLOSE=0 keeps
+        # the fully synchronous path below
+        from .close_pipeline import ClosePipeline
+        import threading
+
+        self.pipeline = ClosePipeline(app)
+        # serializes last_close_phases finalize (close thread) against
+        # the tail's deferred phase publish (worker)
+        self._phases_lock = threading.Lock()
         # per-phase breakdown of the most recent close (ms), plus
         # cumulative phase timers in the metrics registry — the
         # observability the async merge pipeline is judged by.  Timing
@@ -118,13 +129,19 @@ class LedgerManager:
     def last_closed_seq(self) -> int:
         return self.root.header().ledgerSeq
 
-    def _store_lcl(self, header) -> None:
+    def _store_lcl(self, header, lcl_hash: Optional[bytes] = None,
+                   commit: bool = True) -> None:
+        """``commit=False``: the pipelined tail batches this into its
+        single durable transaction (close_pipeline.run_close_tail)."""
+        if lcl_hash is None:
+            lcl_hash = self._lcl_hash
         self.app.database.execute(
             "INSERT INTO persistentstate(statename, state) "
             "VALUES('lastclosedledger', ?) ON CONFLICT(statename) "
             "DO UPDATE SET state=excluded.state",
-            (self._lcl_hash.hex(),))
-        self.app.database.commit()
+            (lcl_hash.hex(),))
+        if commit:
+            self.app.database.commit()
 
     # -- the close path ----------------------------------------------------
 
@@ -149,6 +166,12 @@ class LedgerManager:
                 # JSON + one summary line)
                 if root is not None:
                     tracer.commit_close(close_data.ledger_seq, root)
+        if self.pipeline.enabled and self.pipeline.eager_drain:
+            # test/standalone rigs: make the deferred tail durable
+            # before returning so post-close reads keep sequential
+            # semantics (real nodes overlap; see close_pipeline.py)
+            self.pipeline.drain()
+            self.pipeline.stats["eager_drains"] += 1
 
     def _phase(self, phases: dict, name: str, seconds: float) -> None:
         phases[name] = phases.get(name, 0.0) + seconds * 1000.0
@@ -185,6 +208,10 @@ class LedgerManager:
             # bulk-load the entries this set will touch before the apply
             # loops go key-by-key (ref LedgerTxnRoot::prefetch fed by
             # insertKeysForFeeProcessing/insertLedgerKeysToPrefetch)
+            # (with the pipeline on, the herder already batch-loaded
+            # these keys from the bucket tier at nomination on the
+            # prefetch worker — close_pipeline.stage_prefetch — so for
+            # self-proposed sets this phase is a warm-cache hit)
             with tracer.span("ledger.close.prefetch") as sp:
                 prefetch_keys: set = set()
                 for frame in apply_order:
@@ -348,40 +375,97 @@ class LedgerManager:
                 self.metrics.counter(
                     "bucket.merge.sync-fallback").inc(sync_fb)
 
+            pipelined = self.pipeline.enabled
+            staged_delta = None
             with tracer.span("ledger.close.seal") as sp_seal:
+                if pipelined:
+                    # strict depth-1: ledger N-1's tail must be DURABLE
+                    # before N seals — at most one sealed-but-
+                    # uncommitted ledger ever exists, so a crash always
+                    # recovers to the last durably committed LCL (the
+                    # chaos kill-restore contract)
+                    with tracer.span("ledger.close.tail_wait") as spw:
+                        self.pipeline.barrier()
+                    phases["tail_wait"] = round(spw.seconds * 1000.0, 3)
                 sealed = ltx.header()._replace(bucketListHash=bucket_hash)
                 sealed = self._update_skip_list(sealed)
                 ltx.set_header(sealed)
+                if pipelined:
+                    # the header is final (consensus-visible result):
+                    # install the write-ahead overlay so ledger N+1's
+                    # reads see this delta while the SQL commit runs on
+                    # the tail worker; the LedgerTxn layer is released
+                    # WITHOUT a root commit
+                    staged_delta = ltx._delta
+                    new_header = ltx.header()
+                    ltx.rollback()
+                    self.root.stage_sealed(staged_delta, new_header)
+                    self._lcl_hash = xdr_sha256(T.LedgerHeader,
+                                                new_header)
+                else:
+                    # phase 6: persist tx history rows (SQL, same commit)
+                    self._store_tx_history(close_data.ledger_seq,
+                                           apply_order, tx_result_metas,
+                                           encoded_rows)
+                    ltx.commit()
 
-                # phase 6: persist tx history rows (SQL, same commit)
-                self._store_tx_history(close_data.ledger_seq, apply_order,
-                                       tx_result_metas, encoded_rows)
-                ltx.commit()
+        if pipelined:
+            from .close_pipeline import StagedTail
 
-        with tracer.span("ledger.close.commit") as sp:
-            # the buckets now cover this close's delta: bucket-mode reads
-            # no longer need the commit's sql-ahead overlay copies
-            self.root.note_bucket_applied(
-                kb for kb, _, _ in bucket_changes)
-            new_header = self.root.header()
-            self._lcl_hash = xdr_sha256(T.LedgerHeader, new_header)
-            self._store_lcl(new_header)
-            self._store_bucket_state()
-        self._phase(phases, "commit", sp_seal.seconds + sp.seconds)
+            # the tail's spans hang off the close ROOT (they are
+            # siblings of seal/stage, not children of the submit)
+            tail_parent = tracer.current_id()
+            with tracer.span("ledger.close.stage") as sp:
+                bl = self.app.bucket_manager.bucket_list
+                st = StagedTail(
+                    seq=close_data.ledger_seq,
+                    delta=staged_delta,
+                    header=new_header,
+                    lcl_hash=self._lcl_hash,
+                    apply_order=apply_order,
+                    tx_result_metas=tx_result_metas,
+                    encoded_rows=encoded_rows,
+                    tx_set=tx_set,
+                    upgrade_metas=upgrade_metas,
+                    phases=phases,
+                    parent_token=tail_parent,
+                    # bucket state snapshots: the tail must never read
+                    # the live level list N+1's add_batch mutates
+                    level_hashes=bl.level_hashes(),
+                    sql_ahead_hex=sorted(
+                        kb.hex() for kb in self.root._sql_ahead),
+                    buckets=[b for lv in bl.levels
+                             for b in (lv.curr, lv.snap)
+                             if not b.is_empty()])
+                self.pipeline.submit_tail(st)
+            self._phase(phases, "stage", sp_seal.seconds + sp.seconds)
+        else:
+            with tracer.span("ledger.close.commit") as sp:
+                # the buckets now cover this close's delta: bucket-mode
+                # reads no longer need the commit's sql-ahead overlay
+                # copies
+                self.root.note_bucket_applied(
+                    kb for kb, _, _ in bucket_changes)
+                new_header = self.root.header()
+                self._lcl_hash = xdr_sha256(T.LedgerHeader, new_header)
+                self._store_lcl(new_header)
+                self._store_bucket_state()
+            self._phase(phases, "commit", sp_seal.seconds + sp.seconds)
         self.metrics.counter("ledger.ledger.count").set_count(
             new_header.ledgerSeq)
-        # history: queue + publish checkpoints (ref closeLedger :890-899 —
-        # queueing is crash-safe because the header row committed above in
-        # the same SQL database)
-        with tracer.span("ledger.close.meta") as sp:
-            hm = self.app.history_manager
-            if hm is not None:
-                hm.maybe_queue_history_checkpoint(new_header.ledgerSeq)
-                hm.publish_queued_history()
-            # meta stream for downstream consumers
-            self.app.emit_ledger_close_meta(
-                new_header, tx_set, tx_result_metas, upgrade_metas)
-        self._phase(phases, "meta", sp.seconds)
+        if not pipelined:
+            # history: queue + publish checkpoints (ref closeLedger
+            # :890-899 — queueing is crash-safe because the header row
+            # committed above in the same SQL database)
+            with tracer.span("ledger.close.meta") as sp:
+                hm = self.app.history_manager
+                if hm is not None:
+                    hm.maybe_queue_history_checkpoint(new_header.ledgerSeq)
+                    hm.publish_queued_history()
+                # meta stream for downstream consumers
+                self.app.emit_ledger_close_meta(
+                    new_header, tx_set, tx_result_metas, upgrade_metas)
+            self._phase(phases, "meta", sp.seconds)
         # test hook: a deliberately slowed close to exercise the
         # slow-close watchdog end to end.  Placed AFTER the bucket phase
         # so merges staged on the worker pool this close deterministically
@@ -394,15 +478,19 @@ class LedgerManager:
 
             with tracer.span("ledger.close.test_delay", seconds=delay):
                 sleep(delay)
-        with tracer.span("ledger.close.gc") as sp:
-            self._post_close_gc(new_header.ledgerSeq)
-        self._phase(phases, "gc", sp.seconds)
+        if not pipelined:
+            with tracer.span("ledger.close.gc") as sp:
+                self._post_close_gc(new_header.ledgerSeq)
+            self._phase(phases, "gc", sp.seconds)
         total_sw.__exit__()
         phases["total"] = round(total_sw.seconds * 1000.0, 3)
         phases["sync_fallback_merges"] = sync_fb
-        self.last_close_phases = {
-            k: (round(v, 3) if isinstance(v, float) else v)
-            for k, v in phases.items()}
+        if pipelined:
+            phases["_seq"] = close_data.ledger_seq
+        with self._phases_lock:
+            self.last_close_phases = {
+                k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in phases.items()}
         from ..utils.logging import get_logger
 
         get_logger("Ledger").debug(
@@ -410,6 +498,24 @@ class LedgerManager:
             "bucket %.1fms)", close_data.ledger_seq, len(apply_order),
             phases["total"], phases.get("apply", 0.0),
             phases.get("bucket", 0.0))
+
+    def _publish_tail_phases(self, st, tail_s: dict) -> None:
+        """Tail worker: record the deferred phases' durations — metrics
+        timers always; the close's phase dicts under the publish lock
+        (the close thread may be finalizing them concurrently)."""
+        for name in sorted(tail_s):
+            self.metrics.timer(
+                f"ledger.close.phase.{name}").update(tail_s[name])
+        tail_ms = {name: round(s * 1000.0, 3)
+                   for name, s in tail_s.items()}
+        tail_ms["tail_total"] = round(
+            sum(s for s in tail_s.values()) * 1000.0, 3)
+        tail_ms["tail_deferred"] = True
+        with self._phases_lock:
+            st.phases.update(tail_ms)
+            lcp = self.last_close_phases
+            if lcp is not st.phases and lcp.get("_seq") == st.seq:
+                lcp.update(tail_ms)
 
     def _post_close_gc(self, seq: int) -> None:
         """DEFERRED_GC: young-gen collection after every close, full
@@ -435,37 +541,60 @@ class LedgerManager:
 
         gc.collect(2 if seq % 64 == 0 else 1)
 
-    def _store_bucket_state(self) -> None:
+    def _store_bucket_state(self, level_hashes=None, sql_ahead_hex=None,
+                            commit: bool = True,
+                            run_gc: bool = True) -> None:
         """Persist the bucket-list level hashes so a restarted node can
         reassume its state from the on-disk buckets (ref PersistentState
         kHistoryArchiveState).  Only meaningful with an on-disk bucket
         store; GC of unreferenced bucket files runs AFTER this commit so a
         crash can never leave the persisted hashes pointing at deleted
-        files."""
-        import json
+        files.
 
+        The pipelined tail passes ``level_hashes``/``sql_ahead_hex``
+        snapshots captured on the close thread at seal (the live list
+        may already be mutating under the NEXT close) and batches the
+        rows into its own transaction (``commit=False, run_gc=False``)."""
         bm = self.app.bucket_manager
         if bm.bucket_dir is None:
             return
-        hashes = bm.bucket_list.level_hashes()
+        if level_hashes is None:
+            level_hashes = bm.bucket_list.level_hashes()
+        from contextlib import nullcontext
+
+        # standalone (commit=True) callers group both rows atomically;
+        # the tail passes commit=False and already owns the scope
+        scope = (self.app.database.write_txn() if commit
+                 else nullcontext())
+        with scope:
+            self._store_bucket_state_sql(level_hashes, sql_ahead_hex)
+            if commit:
+                self.app.database.commit()
+        if run_gc:
+            bm.gc_unreferenced()
+
+    def _store_bucket_state_sql(self, level_hashes, sql_ahead_hex
+                                ) -> None:
+        import json
+
         self.app.database.execute(
             "INSERT INTO persistentstate(statename, state) "
             "VALUES('bucketlist', ?) ON CONFLICT(statename) "
             "DO UPDATE SET state=excluded.state",
-            (json.dumps(hashes),))
+            (json.dumps(level_hashes),))
         # the sql-ahead overlay keys persist WITH the bucket state: a
         # restarted node re-verifies the buckets against the header but
         # can never re-derive which keys only ever lived in SQL (genesis
         # root before its first fee debit, test-rig bulk seeds) — losing
         # them would make BucketListDB-mode reads miss live entries
+        if sql_ahead_hex is None:
+            sql_ahead_hex = sorted(kb.hex()
+                                   for kb in self.root._sql_ahead)
         self.app.database.execute(
             "INSERT INTO persistentstate(statename, state) "
             "VALUES('sqlahead', ?) ON CONFLICT(statename) "
             "DO UPDATE SET state=excluded.state",
-            (json.dumps(sorted(kb.hex()
-                               for kb in self.root._sql_ahead)),))
-        self.app.database.commit()
-        bm.gc_unreferenced()
+            (json.dumps(sql_ahead_hex),))
 
     def _collect_changes(self, ltx
                          ) -> List[Tuple[bytes, Optional[object], bool]]:

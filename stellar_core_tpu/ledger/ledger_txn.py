@@ -418,6 +418,16 @@ class LedgerTxnRoot(AbstractLedgerTxn):
         # note_bucket_applied), so in steady state it holds only the
         # never-closed stragglers.
         self._sql_ahead: Dict[bytes, Optional[object]] = {}
+        # -- write-ahead overlay (pipelined close) -------------------------
+        # a SEALED close's delta whose SQL commit is still running on
+        # the close-pipeline tail worker: reads must see it (SQL is one
+        # ledger behind), offer scans must let it shadow SQL rows.
+        # Close-thread only: installed by stage_sealed at seal, dropped
+        # by clear_pending once the tail's commit is durable; the tail
+        # worker writes SQL from its own captured delta reference and
+        # never touches these dicts.
+        self._pending: Dict[bytes, Optional[object]] = {}
+        self._pending_offers: Dict[bytes, Optional[object]] = {}
         self.reads_from_buckets = 0
         self.reads_from_sql = 0
         self.reads_from_overlay = 0
@@ -445,6 +455,61 @@ class LedgerTxnRoot(AbstractLedgerTxn):
         make the bucket list authoritative."""
         self._entry_cache.clear()
         self._sql_ahead.clear()
+        self._pending.clear()
+        self._pending_offers.clear()
+
+    # -- write-ahead overlay (pipelined close) ------------------------------
+
+    def stage_sealed(self, delta: Dict[bytes, Optional[object]],
+                     header) -> None:
+        """Apply a sealed close's IN-MEMORY commit effects now, before
+        its SQL commit runs on the tail worker: write-ahead overlay +
+        entry-cache write-through + header cache.  Mirrors the memory
+        half of _commit_from_child exactly (including the sql-ahead
+        add-then-drop net effect: the bucket list already folded this
+        delta in at phase 5, so the buckets answer for these keys)."""
+        for kb, entry in sorted(delta.items()):
+            if kb.startswith(VIRTUAL_PREFIX):
+                if entry is not None:
+                    raise LedgerTxnError(
+                        "live virtual entry at root commit (unclosed "
+                        "sponsorship)")
+                continue
+            self._pending[kb] = entry
+            if kb.startswith(_OFFER_PREFIX):
+                self._pending_offers[kb] = entry
+            self._cache_put(kb, entry)
+            self._sql_ahead.pop(kb, None)
+        if header is not None:
+            self._header_cache = header
+
+    def clear_pending(self) -> None:
+        """The staged delta is durably committed — SQL answers now."""
+        self._pending.clear()
+        self._pending_offers.clear()
+
+    def commit_pending_sql(self, delta: Dict[bytes, Optional[object]],
+                           header) -> None:
+        """SQL-only half of a root commit, for the close-pipeline tail
+        worker: stage_sealed already ran the memory half on the close
+        thread.  The caller owns transaction boundaries (write_txn +
+        one commit over the whole tail)."""
+        self._commit_sql(self.db.cursor(), delta, header)
+
+    def adopt_prefetch(self, found: Dict[bytes, Optional[object]]
+                       ) -> int:
+        """Fold a worker-prefetched key->entry batch into the entry
+        cache.  Keys the cache/overlays already answer are skipped —
+        those copies are newer than the bucket snapshot the prefetch
+        walked."""
+        n = 0
+        for kb in sorted(found):
+            if kb in self._entry_cache or kb in self._pending or \
+                    kb in self._sql_ahead:
+                continue
+            self._cache_put(kb, found[kb])
+            n += 1
+        return n
 
     def note_bucket_applied(self, kbs) -> None:
         """A ledger close folded these keys into the bucket list — the
@@ -471,6 +536,19 @@ class LedgerTxnRoot(AbstractLedgerTxn):
         the bucket tier's batched lookup — zero SQL on the point path."""
         missing = [kb for kb in kbs if kb not in self._entry_cache]
         n = 0
+        if self._pending:
+            # sealed-but-uncommitted close delta: authoritative over
+            # both SQL (one ledger behind) and the buckets (which agree
+            # — phase 5 folded it in — but the dict hit is cheaper)
+            left = []
+            for kb in missing:
+                if kb in self._pending:
+                    self.reads_from_overlay += 1
+                    self._cache_put(kb, self._pending[kb])
+                    n += 1
+                else:
+                    left.append(kb)
+            missing = left
         if self._bucket_reads_on():
             ask = []
             for kb in missing:
@@ -513,6 +591,11 @@ class LedgerTxnRoot(AbstractLedgerTxn):
             self._entry_cache.move_to_end(kb)
             return cached
         self.cache_misses += 1
+        if self._pending and kb in self._pending:
+            self.reads_from_overlay += 1
+            entry = self._pending[kb]
+            self._cache_put(kb, entry)
+            return entry
         if self._bucket_reads_on():
             if kb in self._sql_ahead:
                 self.reads_from_overlay += 1
@@ -544,24 +627,45 @@ class LedgerTxnRoot(AbstractLedgerTxn):
 
     def _commit_from_child(self, delta: Dict[bytes, Optional[object]],
                            header) -> None:
-        cur = self.db.cursor()
+        from contextlib import nullcontext
+
+        # direct commits serialize against the close pipeline's tail
+        # transaction so neither can commit the other's partial writes
+        # (Database carries the lock; raw sqlite connections in tests
+        # never share threads)
+        lock = getattr(self.db, "write_txn", None)
+        with (lock() if lock is not None else nullcontext()):
+            for kb, entry in sorted(delta.items()):
+                if kb.startswith(VIRTUAL_PREFIX):
+                    if entry is not None:
+                        raise LedgerTxnError(
+                            "live virtual entry at root commit (unclosed "
+                            "sponsorship)")
+                    continue
+                self._cache_put(kb, entry)  # write-through (None=deleted)
+                if self._bucket_list is not None:
+                    # keep the write visible to bucket-mode reads until
+                    # the close folds it into the buckets
+                    # (note_bucket_applied); direct (non-close) commits
+                    # stay here for good.  Tracked even while bucket
+                    # reads are OFF: the overlay key list persists with
+                    # the bucket state, and a node later restarted with
+                    # BUCKETLIST_DB on must still know which entries
+                    # only ever lived in SQL
+                    self._sql_ahead[kb] = entry
+            self._commit_sql(self.db.cursor(), delta, header)
+            if header is not None:
+                self._header_cache = header
+            self.db.commit()
+
+    def _commit_sql(self, cur, delta: Dict[bytes, Optional[object]],
+                    header) -> None:
+        """The SQL statements of a root commit (no commit, no cache or
+        overlay maintenance) — shared by the synchronous commit path
+        and the pipelined tail's ``commit_pending_sql``."""
         for kb, entry in sorted(delta.items()):
             if kb.startswith(VIRTUAL_PREFIX):
-                if entry is not None:
-                    raise LedgerTxnError(
-                        "live virtual entry at root commit (unclosed "
-                        "sponsorship)")
                 continue
-            self._cache_put(kb, entry)  # write-through (None = deleted)
-            if self._bucket_list is not None:
-                # keep the write visible to bucket-mode reads until the
-                # close folds it into the buckets (note_bucket_applied);
-                # direct (non-close) commits stay here for good.  Tracked
-                # even while bucket reads are OFF: the overlay key list
-                # persists with the bucket state, and a node later
-                # restarted with BUCKETLIST_DB on must still know which
-                # entries only ever lived in SQL
-                self._sql_ahead[kb] = entry
             if entry is None:
                 cur.execute("DELETE FROM ledgerentries WHERE key = ?", (kb,))
                 cur.execute("DELETE FROM offers WHERE key = ?", (kb,))
@@ -603,8 +707,6 @@ class LedgerTxnRoot(AbstractLedgerTxn):
                 "INSERT INTO ledgerheaders(ledgerseq, data) VALUES(?,?) "
                 "ON CONFLICT(ledgerseq) DO UPDATE SET data=excluded.data",
                 (header.ledgerSeq, hb))
-            self._header_cache = header
-        self.db.commit()
 
     # -- order-book scan ---------------------------------------------------
 
@@ -619,6 +721,10 @@ class LedgerTxnRoot(AbstractLedgerTxn):
         ``price`` column only prefilters the SQL scan, so two distinct
         rationals colliding in double precision cannot flip the crossing
         order — the float tie-run is re-compared exactly below."""
+        if self._pending_offers:
+            # sealed-but-uncommitted close delta shadows SQL rows; the
+            # open txn's own overrides stay newest
+            overrides = {**self._pending_offers, **overrides}
         candidates = []
         q = ("SELECT key, pricen, priced, offerid FROM offers "
              "WHERE selling = ? AND buying = ? ORDER BY price, offerid")
@@ -659,29 +765,56 @@ class LedgerTxnRoot(AbstractLedgerTxn):
         return e
 
     def _entries_by_key_prefix(self, prefix: bytes):
+        pend = self._pending
         hi = prefix + b"\xff" * 8
         for kb, blob in self.db.execute(
                 "SELECT key, entry FROM ledgerentries "
                 "WHERE key >= ? AND key <= ?", (prefix, hi)):
-            if kb.startswith(prefix):
+            if kb.startswith(prefix) and kb not in pend:
                 yield kb, T.LedgerEntry.decode(blob)
+        if pend:
+            for kb in sorted(pend):
+                if kb.startswith(prefix) and pend[kb] is not None:
+                    yield kb, pend[kb]
 
     def _offers_by_pair(self, selling: bytes, buying: bytes):
         """Every resting offer of one book direction — the parallel-apply
-        planner's order-book materialization (plan-time, main thread)."""
+        planner's order-book materialization (plan-time, main thread).
+        The write-ahead overlay shadows SQL rows; consumers sort the
+        rows themselves, so the appended overlay offers need no order
+        merge."""
+        pend = self._pending_offers
         for kb, blob in self.db.execute(
                 "SELECT o.key, e.entry FROM offers o "
                 "JOIN ledgerentries e ON e.key = o.key "
                 "WHERE o.selling = ? AND o.buying = ? "
                 "ORDER BY o.price, o.offerid", (selling, buying)):
-            yield kb, T.LedgerEntry.decode(blob)
+            if kb not in pend:
+                yield kb, T.LedgerEntry.decode(blob)
+        if pend:
+            for kb in sorted(pend):
+                e = pend[kb]
+                if e is None:
+                    continue
+                o = e.data.value
+                if (T.Asset.encode(o.selling) == selling
+                        and T.Asset.encode(o.buying) == buying):
+                    yield kb, e
 
     def _offers_by_seller(self, sellerid: bytes):
+        pend = self._pending_offers
         for kb, blob in self.db.execute(
                 "SELECT o.key, e.entry FROM offers o "
                 "JOIN ledgerentries e ON e.key = o.key "
                 "WHERE o.sellerid = ?", (sellerid,)):
-            yield kb, T.LedgerEntry.decode(blob)
+            if kb not in pend:
+                yield kb, T.LedgerEntry.decode(blob)
+        if pend:
+            for kb in sorted(pend):
+                e = pend[kb]
+                if e is not None and \
+                        e.data.value.sellerID.value == sellerid:
+                    yield kb, e
 
     def count_entries(self) -> int:
         return self.db.execute(

@@ -279,6 +279,11 @@ class Application:
         Every timer tagged with this app is swept so no callback fires
         into freed subsystems; on-disk state (DATABASE file + bucket
         store) survives for a restart-from-state rebuild."""
+        # the close pipeline first: its tail worker holds the database
+        # and bucket store, both torn down below (drains the in-flight
+        # tail; an abandoned tail — the chaos pipeline-window crash —
+        # was already discarded via crash_abandon)
+        self.ledger_manager.pipeline.shutdown()
         self.process_manager.shutdown()
         self.parallel_apply.shutdown()
         self.bucket_manager.shutdown()

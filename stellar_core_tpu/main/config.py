@@ -213,6 +213,29 @@ class Config:
             "PARALLEL_APPLY_STATS_FILE",
             _os.environ.get("PARALLEL_APPLY_STATS_FILE"))
 
+        # pipelined ledger close (ledger/close_pipeline.py): after the
+        # header seals, the commit/meta/tx-history/gc tail runs on a
+        # worker while the herder triggers the next ledger, with a
+        # write-ahead read overlay and a strict depth-1 barrier (the
+        # next close's seal waits for the previous tail's durable
+        # commit).  PIPELINED_CLOSE=0 (env or config) is the kill
+        # switch: the fully synchronous close path, bit-identical
+        # results either way (tests/test_pipelined_close.py).
+        self.PIPELINED_CLOSE: bool = kw.get(
+            "PIPELINED_CLOSE",
+            _os.environ.get("PIPELINED_CLOSE", "1") != "0")
+        # drain the tail before close_ledger returns.  None resolves to
+        # MANUAL_CLOSE: test/standalone rigs keep sequential read
+        # semantics, real nodes overlap.  Benches and overlap tests set
+        # False explicitly.
+        self.PIPELINED_CLOSE_EAGER_DRAIN: Optional[bool] = kw.get(
+            "PIPELINED_CLOSE_EAGER_DRAIN")
+        # one JSON line of pipeline session stats at shutdown —
+        # tools/verify_green.py's pipelined smoke aggregates these
+        self.PIPELINED_CLOSE_STATS_FILE: Optional[str] = kw.get(
+            "PIPELINED_CLOSE_STATS_FILE",
+            _os.environ.get("PIPELINED_CLOSE_STATS_FILE"))
+
         # surge-pricing DEX lane: ops from DEX transactions (offers +
         # path payments) admitted per ledger, on top of the total
         # maxTxSetSize cap (ref SurgePricingUtils.h lane config /
@@ -455,6 +478,11 @@ def test_config(n: int = 0, **kw) -> Config:
         # exported, which flips every test Application to parallel
         PARALLEL_APPLY_WORKERS=int(
             os.environ.get("PARALLEL_APPLY_WORKERS", "0") or 0),
+        # same discipline for the pipelined close: off in the default
+        # tier-1 pass, flipped on suite-wide by verify_green's
+        # PIPELINED_CLOSE=1 smoke (MANUAL_CLOSE rigs then eager-drain
+        # per close, so post-close reads keep sequential semantics)
+        PIPELINED_CLOSE=os.environ.get("PIPELINED_CLOSE", "0") == "1",
     )
     defaults.update(kw)
     return Config(**defaults)

@@ -114,12 +114,21 @@ class LoadGenerator:
                                  amount=amount)))
         return self._sign_tx(src, [op], fee)
 
-    def _payment_dest(self, accts: List[SecretKey], i: int) -> bytes:
+    def _payment_dest(self, accts: List[SecretKey], i: int,
+                      dest_accounts: Optional[List[SecretKey]] = None
+                      ) -> bytes:
         """Destination for payment i: ``ring`` (each account pays its
         successor — one fully-connected conflict component, the
         parallel-apply worst case) or ``pairs`` (2j <-> 2j+1 — disjoint
         account pairs, the independent-users shape real traffic
-        approximates and conflict clustering can spread)."""
+        approximates and conflict clustering can spread).
+
+        ``dest_accounts``: draw destinations from a DIFFERENT pool than
+        the sources (payment i -> dest_accounts[i]) — the recipients-
+        aren't-senders shape, where admission never pre-warms the
+        destination entries and the close's prefetch does real work."""
+        if dest_accounts is not None:
+            return dest_accounts[i % len(dest_accounts)].public_key().raw
         k = len(accts)
         if self.payment_pattern == "pairs":
             j = i % k
@@ -130,7 +139,8 @@ class LoadGenerator:
         return accts[(i + 1) % k].public_key().raw
 
     def generate_payments(self, n: int,
-                          accounts: Optional[List[SecretKey]] = None
+                          accounts: Optional[List[SecretKey]] = None,
+                          dest_accounts: Optional[List[SecretKey]] = None
                           ) -> List:
         """n one-op payments round-robin across the account pool
         (destination graph per ``payment_pattern``; sequence numbers
@@ -141,7 +151,7 @@ class LoadGenerator:
         k = len(accts)
         for i in range(n):
             src = accts[i % k]
-            dest = self._payment_dest(accts, i)
+            dest = self._payment_dest(accts, i, dest_accounts)
             out.append(self.payment_envelope(src, dest, 1 + (i % 1000)))
         return out
 
@@ -236,7 +246,8 @@ class LoadGenerator:
         return self._sign_tx(src, [op], fee)
 
     def generate_mixed(self, n: int, dex_percent: int = 50,
-                       accounts: Optional[List[SecretKey]] = None
+                       accounts: Optional[List[SecretKey]] = None,
+                       dest_accounts: Optional[List[SecretKey]] = None
                        ) -> List:
         """Payments + DEX offers at ``dex_percent`` (ref MIXED_TXS
         :308-318; deterministic pseudo-mix instead of the reference's
@@ -255,7 +266,7 @@ class LoadGenerator:
                 out.append(self.offer_envelope(
                     src, 10 + i % 90, 100 + (i % 50), 100))
             else:
-                dest = self._payment_dest(accts, i)
+                dest = self._payment_dest(accts, i, dest_accounts)
                 out.append(self.payment_envelope(src, dest,
                                                  1 + (i % 1000)))
         return out

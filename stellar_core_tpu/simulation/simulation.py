@@ -73,6 +73,12 @@ class Simulation:
                 "DATABASE", os.path.join(node_dir, "node.db"))
             config_kw.setdefault(
                 "BUCKET_DIR_PATH_REAL", os.path.join(node_dir, "buckets"))
+        # sims default the close pipeline OFF: a 50-validator network
+        # in one process would own 50 tail workers for no modelled
+        # benefit, and the scripted chaos wall-cost budget predates it.
+        # Pipeline-specific sim tests (the chaos pipeline-window
+        # kill-restore) opt in per node via config_kw.
+        config_kw.setdefault("PIPELINED_CLOSE", False)
         return Config(
             NETWORK_PASSPHRASE=self.network_passphrase,
             NODE_SEED=recipe["seed"],

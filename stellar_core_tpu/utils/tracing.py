@@ -51,13 +51,18 @@ DEFAULT_RING_CLOSES = 8
 
 class Span:
     """One finished (or in-flight) span.  ``seconds`` is valid after
-    __exit__ even when the tracer is disabled."""
+    __exit__ even when the tracer is disabled.
+
+    ``close_seq``: cross-CLOSE parenting for the pipelined close tail —
+    a span that runs during ledger N+1 but belongs to ledger N's close
+    (deferred commit/meta/gc) carries N here and is routed into N's
+    already-committed ring record instead of the pending deque."""
 
     __slots__ = ("name", "span_id", "parent_id", "tid", "thread_name",
-                 "t0", "t1", "args", "_tracer")
+                 "t0", "t1", "args", "close_seq", "_tracer")
 
     def __init__(self, tracer, name: str, parent_id: Optional[int],
-                 args: Optional[dict]):
+                 args: Optional[dict], close_seq: Optional[int] = None):
         self._tracer = tracer
         self.name = name
         self.parent_id = parent_id
@@ -67,6 +72,7 @@ class Span:
         self.t0 = 0.0
         self.t1 = 0.0
         self.args = args
+        self.close_seq = close_seq
 
     @property
     def seconds(self) -> float:
@@ -231,14 +237,40 @@ class Tracer:
             return self._id_counter
 
     def _record(self, sp: Span) -> None:
+        if sp.close_seq is not None and self._route_late(sp):
+            return
         with self._lock:
             self._pending.append(sp)
 
+    def _route_late(self, sp: Span) -> bool:
+        """Append a close-tagged span to its (already committed) close
+        record in the ring — the pipelined tail's spans finish during
+        the NEXT close but belong to ledger ``close_seq``.  False when
+        that record does not exist yet (the span finished before
+        commit_close ran): the pending drain then files it correctly."""
+        with self._lock:
+            for rec in reversed(self._ring):
+                if rec.seq == sp.close_seq:
+                    if len(rec.spans) >= MAX_SPANS_PER_CLOSE:
+                        rec.truncated += 1
+                    else:
+                        rec.spans.append(sp)
+                    break
+            else:
+                return False
+        if self.metrics is not None:
+            self.metrics.timer(f"span.{sp.name}").update(sp.seconds)
+        return True
+
     def span(self, name: str, parent: Optional[int] = None,
-             **args) -> Span:
+             close_seq: Optional[int] = None, **args) -> Span:
         """Nestable span context manager.  ``parent`` overrides the
-        thread-local nesting (cross-thread parenting)."""
-        return Span(self, name, parent, args or None)
+        thread-local nesting (cross-thread parenting); ``close_seq``
+        routes the finished span into that ledger's close record even
+        when it outlives the close (cross-close parenting — the
+        pipelined tail)."""
+        return Span(self, name, parent, args or None,
+                    close_seq=close_seq)
 
     def current_id(self) -> Optional[int]:
         """Token for cross-thread parenting: the innermost open span on
@@ -275,18 +307,26 @@ class Tracer:
         (the root span must already be closed)."""
         if not self.enabled:
             return None
+        # drain + ring-append under one lock hold: a tail span finishing
+        # concurrently either lands in the pending drain (filed here) or
+        # sees the new record and routes itself (_route_late) — never
+        # neither, never both
         with self._lock:
             spans = list(self._pending)
             self._pending.clear()
-        truncated = 0
-        if len(spans) > MAX_SPANS_PER_CLOSE:
-            truncated = len(spans) - MAX_SPANS_PER_CLOSE
-            spans = spans[-MAX_SPANS_PER_CLOSE:]
-        rec = CloseRecord(seq, root.span_id, root.seconds, spans,
-                          truncated)
-        self._ring.append(rec)
-        if self.metrics is not None:
-            self._update_span_timers(rec)
+            truncated = 0
+            if len(spans) > MAX_SPANS_PER_CLOSE:
+                truncated = len(spans) - MAX_SPANS_PER_CLOSE
+                spans = spans[-MAX_SPANS_PER_CLOSE:]
+            rec = CloseRecord(seq, root.span_id, root.seconds, spans,
+                              truncated)
+            self._ring.append(rec)
+            if self.metrics is not None:
+                # inside the lock on purpose: a tail span routed into
+                # this record by _route_late after the append updates
+                # its own timer there — counting it here too would
+                # double-sample it
+                self._update_span_timers(rec)
         thr = self.slow_close_threshold
         if thr is not None and thr > 0 and root.seconds > thr:
             self._watchdog_fire(rec)
@@ -303,7 +343,8 @@ class Tracer:
             self.metrics.timer(f"span.{name}").update(totals[name])
 
     def closes(self) -> List[CloseRecord]:
-        return list(self._ring)
+        with self._lock:
+            return list(self._ring)
 
     def get_close(self, seq: Optional[int] = None) -> Optional[CloseRecord]:
         """The ring record for ledger ``seq`` (latest when None)."""

@@ -267,3 +267,54 @@ def test_slot_bracket_uncaps_when_not_tracking():
     lo2, hi2 = h.scp_slot_bracket()
     assert lo2 == lo
     assert hi2 > 2 ** 62
+
+
+# -- pipelined-close crash window (ISSUE 11 satellite) -----------------------
+
+
+def test_crash_in_pipeline_window_recovers_to_durable_lcl(tmp_path):
+    """Kill a validator BETWEEN seal and deferred commit (ledger N's
+    tail parked on the close-pipeline worker): its durable state is
+    N-1, and the restart-from-state path must come back at that LCL —
+    the last *durably committed* ledger — then rejoin and converge with
+    the survivors without forking."""
+    import threading
+
+    sim = core(4, persist_dir=str(tmp_path), MANUAL_CLOSE=False,
+               PIPELINED_CLOSE=True, PIPELINED_CLOSE_EAGER_DRAIN=False)
+    sim.start_all_nodes()
+    victim = list(sim.nodes)[0]
+    assert sim.crank_until(lambda: sim.have_all_externalized(3), 60.0)
+    vapp = sim.nodes[victim]
+    pipeline = vapp.ledger_manager.pipeline
+    assert pipeline.enabled and pipeline.stats["tails"] > 0
+
+    # park the victim's NEXT tail: the close seals and the herder keeps
+    # going, but the durable commit never lands — the pipeline window
+    hold = threading.Event()
+    pipeline._hold = hold
+    target = vapp.ledger_manager.last_closed_seq() + 1
+    assert sim.crank_until(
+        lambda: vapp.ledger_manager.last_closed_seq() >= target, 60.0)
+    seq_sealed = vapp.ledger_manager.last_closed_seq()
+    durable = vapp.database.execute(
+        "SELECT MAX(ledgerseq) FROM ledgerheaders").fetchone()[0]
+    assert durable == seq_sealed - 1, \
+        "expected exactly one sealed-but-uncommitted ledger (depth-1)"
+
+    # crash INSIDE the window: the parked tail must never commit
+    pipeline.crash_abandon()
+    sim.crash_node(victim)
+
+    restarted = sim.restart_node(victim)
+    assert restarted.ledger_manager.last_closed_seq() == seq_sealed - 1, \
+        "restart must land on the last DURABLY committed LCL"
+
+    # rejoin under live traffic and converge with the survivors
+    goal = max(app.ledger_manager.last_closed_seq()
+               for app in sim.alive_nodes().values()) + 2
+    assert sim.crank_until(lambda: sim.have_all_externalized(goal),
+                           120.0), "crash victim never rejoined"
+    sim.assert_no_forks()
+    for nid in list(sim.alive_nodes()):
+        sim.nodes[nid].stop_node()

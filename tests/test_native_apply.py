@@ -264,10 +264,18 @@ def test_native_cluster_spans_reach_the_trace_endpoint():
     code, body = handler.handle("trace", {"ledger": str(seq)})
     assert code == 200
     trace = json.loads(body.data.decode())
+    # small kernel-eligible clusters coalesce into batched crossings
+    # (ROADMAP 2d); a lone trailing cluster still spans per-cluster
     native_events = [e for e in trace["traceEvents"]
-                     if e["name"] == "ledger.apply.cluster.native"]
+                     if e["name"] in ("ledger.apply.cluster.native",
+                                      "ledger.apply.cluster.native.batch")]
     assert native_events, "no native cluster spans in the close trace"
     assert all(e["args"].get("outcome") == "hit" for e in native_events)
+    batch_events = [e for e in native_events
+                    if e["name"].endswith(".batch")]
+    assert batch_events, "expected a batched kernel crossing"
+    assert all(e["args"]["clusters"] >= 2 for e in batch_events)
+    assert app.parallel_apply.stats["batched_clusters"] >= 2
     app.graceful_stop()
 
 

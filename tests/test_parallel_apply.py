@@ -267,7 +267,8 @@ def test_cluster_spans_reach_the_trace_endpoint():
     trace = json.loads(body.data.decode())
     cluster_events = [e for e in trace["traceEvents"]
                       if e["name"] in ("ledger.apply.cluster",
-                                       "ledger.apply.cluster.native")]
+                                       "ledger.apply.cluster.native",
+                                       "ledger.apply.cluster.native.batch")]
     assert cluster_events, "no cluster spans in the close trace"
     # cross-thread parenting: cluster spans parent into the apply span
     by_id = {e["args"]["span_id"]: e for e in trace["traceEvents"]}

@@ -1,0 +1,338 @@
+"""Pipelined close determinism + contract tests (ISSUE 11 tentpole).
+
+The pipeline moves ledger N's commit/meta/tx-history/gc tail onto a
+worker while N+1 begins, behind a write-ahead read overlay and a
+strict depth-1 barrier.  None of that may change a single consensus
+byte: header hashes, bucket hashes AND meta bytes must be identical
+pipeline-on vs pipeline-off, under hash-seed variation, with the tail
+genuinely overlapping (eager drain off) and with the kill switch.
+"""
+import os
+import subprocess
+import sys
+import threading
+
+from stellar_core_tpu.main import Application, test_config
+from stellar_core_tpu.main.http_server import CommandHandler
+from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+from stellar_core_tpu.xdr import types as T
+
+
+def _mk_app(pipelined, eager=None, **kw):
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), test_config(
+        TESTING_UPGRADE_MAX_TX_SET_SIZE=200,
+        PIPELINED_CLOSE=pipelined,
+        PIPELINED_CLOSE_EAGER_DRAIN=eager,
+        **kw))
+    app.start()
+    return app
+
+
+def run_workload(pipelined, eager=None, dex=True, **kw):
+    """Deterministic mixed workload through the full node close path;
+    returns per-close (ledger hash, bucket hash, meta bytes)
+    fingerprints.  With ``eager=False`` the tail genuinely overlaps the
+    next close's admission + close work; fingerprints are read from
+    memory (always current) and the meta stream after a final drain."""
+    app = _mk_app(pipelined, eager=eager, **kw)
+    handler = CommandHandler(app)
+    hashes = []
+
+    def close():
+        app.herder.manual_close()
+        hashes.append((app.ledger_manager.last_closed_hash(),
+                       app.bucket_manager.get_bucket_list_hash()))
+
+    code, body = handler.handle("generateload",
+                                {"mode": "create", "accounts": "24"})
+    assert code == 200, body
+    close()
+    for _ in range(2):  # issuer, trustlines, funding
+        code, body = handler.handle("generateload",
+                                    {"mode": "mixed", "txs": "48"})
+        assert code == 200, body
+        close()
+    for _ in range(3):
+        params = {"mode": "mixed", "txs": "48"}
+        if dex:
+            params["dexpct"] = "40"
+        code, body = handler.handle("generateload", params)
+        assert code == 200, body
+        close()
+    app.ledger_manager.pipeline.drain()
+    metas = [T.LedgerCloseMeta.encode(m) for m in app._meta_stream]
+    stats = dict(app.ledger_manager.pipeline.stats)
+    app.graceful_stop()
+    assert len(metas) == len(hashes)
+    return [h + (m,) for h, m in zip(hashes, metas)], stats
+
+
+def _assert_identical(a, b, label):
+    assert len(a) == len(b)
+    for i, (ra, rb) in enumerate(zip(a, b)):
+        assert ra[0] == rb[0], f"[{label}] ledger hash diverged @ {i}"
+        assert ra[1] == rb[1], f"[{label}] bucket hash diverged @ {i}"
+        assert ra[2] == rb[2], f"[{label}] meta bytes diverged @ {i}"
+
+
+def test_pipeline_on_off_bit_identical_mixed():
+    """The acceptance gate: header/bucket hashes AND meta bytes are
+    bit-identical pipeline-on (true overlap, eager drain off) vs
+    pipeline-off, over a mixed payment+DEX workload."""
+    off, _ = run_workload(False)
+    on, stats = run_workload(True, eager=False)
+    _assert_identical(off, on, "pipeline on/off")
+    assert stats["tails"] == len(on)
+    assert stats["tail_failures"] == 0
+    # the footprint prefetch actually engaged and fed the close
+    assert stats["prefetch_staged"] > 0
+    assert stats["prefetch_adopted"] > 0
+
+
+def test_kill_switch_parity_and_eager_drain():
+    """PIPELINED_CLOSE=0 (kill switch) and the eager-drain test-rig
+    mode both reproduce the same bytes as the overlapping pipeline."""
+    on, _ = run_workload(True, eager=False)
+    eager, st = run_workload(True, eager=None)  # MANUAL_CLOSE -> drain
+    _assert_identical(on, eager, "eager drain")
+    assert st["eager_drains"] == len(eager)
+    killed, st2 = run_workload(False)
+    _assert_identical(on, killed, "kill switch")
+    assert st2["tails"] == 0
+
+
+def test_overlay_serves_next_close_reads_while_tail_held():
+    """The write-ahead overlay: with ledger N's tail parked on the
+    worker (test hold hook), N's delta must be visible through the
+    root (point gets, header, offer scans) while SQL still holds N-1;
+    releasing the hold makes SQL catch up and drops the overlay."""
+    app = _mk_app(True, eager=False)
+    handler = CommandHandler(app)
+    code, body = handler.handle("generateload",
+                                {"mode": "create", "accounts": "8"})
+    assert code == 200, body
+    app.herder.manual_close()
+    app.ledger_manager.pipeline.drain()
+    lm = app.ledger_manager
+    root = lm.root
+    seq_before = lm.last_closed_seq()
+    durable_before = app.database.execute(
+        "SELECT MAX(ledgerseq) FROM ledgerheaders").fetchone()[0]
+
+    hold = threading.Event()
+    lm.pipeline._hold = hold
+    try:
+        code, body = handler.handle("generateload",
+                                    {"mode": "pay", "txs": "8"})
+        assert code == 200, body
+        app.herder.manual_close()
+        # memory state is at N; durable state still at N-1
+        assert lm.last_closed_seq() == seq_before + 1
+        assert root._pending, "write-ahead overlay not installed"
+        durable_mid = app.database.execute(
+            "SELECT MAX(ledgerseq) FROM ledgerheaders").fetchone()[0]
+        assert durable_mid == durable_before
+        # a key from the sealed delta reads back through the overlay
+        kb = sorted(root._pending)[0]
+        assert root.get(kb) == root._pending[kb]
+        assert root.header().ledgerSeq == seq_before + 1
+    finally:
+        lm.pipeline._hold = None
+        hold.set()
+    lm.pipeline.drain()
+    assert not root._pending, "overlay must drop once the tail commits"
+    durable_after = app.database.execute(
+        "SELECT MAX(ledgerseq) FROM ledgerheaders").fetchone()[0]
+    assert durable_after == seq_before + 1
+    app.graceful_stop()
+
+
+def test_depth_one_barrier_blocks_next_seal():
+    """Strict depth-1: with N's tail held, close N+1 must block at its
+    seal (never producing a second uncommitted ledger) until N's tail
+    commits durably."""
+    app = _mk_app(True, eager=False)
+    handler = CommandHandler(app)
+    code, body = handler.handle("generateload",
+                                {"mode": "create", "accounts": "8"})
+    assert code == 200, body
+    app.herder.manual_close()
+    app.ledger_manager.pipeline.drain()
+    lm = app.ledger_manager
+
+    hold = threading.Event()
+    lm.pipeline._hold = hold
+    code, body = handler.handle("generateload", {"mode": "pay",
+                                                 "txs": "8"})
+    assert code == 200, body
+    app.herder.manual_close()
+    seq_n = lm.last_closed_seq()
+
+    done = threading.Event()
+
+    def close_next():
+        # the next close runs up to its seal, then barriers on N's tail
+        handler.handle("generateload", {"mode": "pay", "txs": "8"})
+        app.herder.manual_close()
+        done.set()
+
+    t = threading.Thread(target=close_next, daemon=True)
+    t.start()
+    # the barrier must hold N+1's seal while N's tail is parked
+    assert not done.wait(0.3), "close N+1 sealed before N was durable"
+    durable = app.database.execute(
+        "SELECT MAX(ledgerseq) FROM ledgerheaders").fetchone()[0]
+    assert durable <= seq_n - 1
+    lm.pipeline._hold = None
+    hold.set()
+    assert done.wait(30.0), "close N+1 never completed after release"
+    t.join()
+    lm.pipeline.drain()
+    assert lm.last_closed_seq() == seq_n + 1
+    durable = app.database.execute(
+        "SELECT MAX(ledgerseq) FROM ledgerheaders").fetchone()[0]
+    assert durable == seq_n + 1
+    app.graceful_stop()
+
+
+def test_tail_failure_is_sticky_and_loud():
+    """A failed tail must fail the NEXT close's barrier (the node must
+    not keep closing over a commit that never became durable)."""
+    import pytest
+
+    from stellar_core_tpu.ledger.close_pipeline import (StagedTail,
+                                                        TailFailure)
+
+    # the forced failure below is the TEST SUBJECT — keep it out of the
+    # session stats file verify_green's pipelined smoke aggregates (a
+    # real failure there must stay a red flag)
+    app = _mk_app(True, eager=False, PIPELINED_CLOSE_STATS_FILE=None)
+    handler = CommandHandler(app)
+    code, body = handler.handle("generateload",
+                                {"mode": "create", "accounts": "4"})
+    assert code == 200, body
+    pipeline = app.ledger_manager.pipeline
+    pipeline.drain()
+
+    class Boom(StagedTail):
+        def live_hashes(self):
+            raise RuntimeError("forced tail failure")
+
+    st = Boom(seq=999999, delta={}, header=None, lcl_hash=b"\x00" * 32,
+              apply_order=[], tx_result_metas=[], encoded_rows=None,
+              tx_set=None, upgrade_metas=[], phases={},
+              parent_token=None, level_hashes=[], sql_ahead_hex=[],
+              buckets=[])
+    pipeline.submit_tail(st)
+    with pytest.raises(TailFailure):
+        pipeline.barrier()
+    with pytest.raises(TailFailure):
+        pipeline.barrier()  # sticky: stays red until intervention
+    assert pipeline.stats["tail_failures"] == 1
+    # shutdown logs (not raises) so teardown still completes
+    app.graceful_stop()
+
+
+def test_footprint_prefetch_warms_the_close():
+    """Nomination-time exact-key prefetch: the herder's trigger stages
+    the candidates' declared keys through the bucket tier on a worker
+    and adopts them before the preplan; the trigger/close path then
+    performs zero SQL point reads (bucket tier + overlay serve it)."""
+    app = _mk_app(True, eager=False)
+    handler = CommandHandler(app)
+    code, body = handler.handle("generateload",
+                                {"mode": "create", "accounts": "16"})
+    assert code == 200, body
+    app.herder.manual_close()
+    # fold the direct-seeded accounts off the sql-ahead overlay into
+    # the buckets (a close writing them), then chill the cache so the
+    # next trigger's prefetch has real bucket work
+    code, body = handler.handle("generateload", {"mode": "pay",
+                                                 "txs": "16"})
+    assert code == 200, body
+    app.herder.manual_close()
+    app.ledger_manager.pipeline.drain()
+    root = app.ledger_manager.root
+    assert root.bucket_reads_enabled
+    # admit first (admission's fee checks warm the sources), then chill
+    # the cache so the TRIGGER's staged prefetch is what re-warms it
+    code, body = handler.handle("generateload", {"mode": "pay",
+                                                 "txs": "16"})
+    assert code == 200, body
+    root._entry_cache.clear()
+    sql_before = root.reads_from_sql
+    app.herder.manual_close()
+    stats = app.ledger_manager.pipeline.stats
+    assert stats["prefetch_staged"] >= 1
+    assert stats["prefetch_keys"] > 0
+    assert stats["prefetch_adopted"] > 0, \
+        "staged prefetch never warmed the cache"
+    assert root.reads_from_sql == sql_before, \
+        "close-thread SQL point reads with the bucket tier on"
+    app.graceful_stop()
+
+
+_HASHSEED_WORKER = """
+import hashlib
+import sys
+
+sys.path.insert(0, {repo!r})
+from tests.test_pipelined_close import run_workload
+
+for lh, bh, meta in run_workload(True, eager=False)[0]:
+    print(lh.hex(), bh.hex(), hashlib.sha256(meta).hexdigest())
+"""
+
+
+def test_pipelined_close_bit_identical_under_hashseed_variation():
+    """Two subprocesses under different PYTHONHASHSEED values run the
+    pipelined (overlapping) workload; every per-close fingerprint must
+    match — the same discipline test_apply_determinism pins for the
+    apply path, extended over the staged tail."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    outputs = []
+    for seed in ("0", "4242"):
+        env = dict(os.environ)
+        env["PYTHONHASHSEED"] = seed
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PIPELINED_CLOSE", None)
+        proc = subprocess.run(
+            [sys.executable, "-c", _HASHSEED_WORKER.format(repo=repo)],
+            capture_output=True, text=True, cwd=repo, env=env,
+            timeout=600)
+        assert proc.returncode == 0, proc.stderr[-4000:]
+        lines = proc.stdout.strip().splitlines()
+        assert len(lines) >= 6, proc.stdout
+        outputs.append(lines)
+    a, b = outputs
+    assert a == b, "pipelined close fingerprints diverged across " \
+        "PYTHONHASHSEED values"
+
+
+def test_restart_from_pipelined_state(tmp_path):
+    """A node that closed ledgers with the pipeline on (drained) must
+    restart from its on-disk state exactly like a synchronous node:
+    hash-verified bucket restore, same LCL."""
+    node_dir = tmp_path / "node"
+    node_dir.mkdir()
+    kw = dict(DATABASE=str(node_dir / "node.db"),
+              BUCKET_DIR_PATH_REAL=str(node_dir / "buckets"))
+    app = _mk_app(True, eager=False, **kw)
+    handler = CommandHandler(app)
+    code, body = handler.handle("generateload",
+                                {"mode": "create", "accounts": "8"})
+    assert code == 200, body
+    app.herder.manual_close()
+    code, body = handler.handle("generateload", {"mode": "pay",
+                                                 "txs": "8"})
+    assert code == 200, body
+    app.herder.manual_close()
+    seq = app.ledger_manager.last_closed_seq()
+    lcl = app.ledger_manager.last_closed_hash()
+    app.graceful_stop()  # drains the tail, then tears down
+
+    app2 = _mk_app(True, eager=False, **kw)
+    assert app2.ledger_manager.last_closed_seq() == seq
+    assert app2.ledger_manager.last_closed_hash() == lcl
+    assert app2.ledger_manager.root.bucket_reads_enabled
+    app2.graceful_stop()

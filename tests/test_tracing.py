@@ -317,3 +317,82 @@ def test_self_time_summary_subtracts_children():
     assert by_name["inner"]["self_ms"] > by_name["outer"]["self_ms"]
     doc = chrome_trace(rec)
     assert len(doc["traceEvents"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# cross-CLOSE parenting (pipelined close tail; ISSUE 11 satellite)
+# ---------------------------------------------------------------------------
+
+def test_cross_close_token_routes_late_spans_to_their_ledger():
+    """A span tagged close_seq=N that finishes AFTER commit_close(N)
+    (the pipelined tail running during ledger N+1) must land in N's
+    ring record, not leak into N+1's pending drain."""
+    tr = Tracer(enabled=True)
+    with tr.span("ledger.close", ledger=7) as root7:
+        pass
+    rec7 = tr.commit_close(7, root7)
+    # the deferred tail finishes later, from another thread
+    done = threading.Event()
+
+    def tail(token):
+        with tr.span("ledger.close.commit", parent=token, close_seq=7):
+            pass
+        done.set()
+
+    t = threading.Thread(target=tail, args=(root7.span_id,))
+    t.start()
+    assert done.wait(5.0)
+    t.join()
+    names7 = [sp.name for sp in rec7.spans]
+    assert "ledger.close.commit" in names7
+    # ...and the NEXT close's record stays clean of N's tail
+    with tr.span("ledger.close", ledger=8) as root8:
+        pass
+    rec8 = tr.commit_close(8, root8)
+    assert "ledger.close.commit" not in [sp.name for sp in rec8.spans]
+    # the routed span still parents into N's root
+    tail_span = next(sp for sp in rec7.spans
+                     if sp.name == "ledger.close.commit")
+    assert tail_span.parent_id == root7.span_id
+
+
+def test_cross_close_token_before_commit_falls_into_pending():
+    """A close-tagged span finishing BEFORE its close record exists
+    (fast tail) stays in the pending deque and is drained into the
+    right record by commit_close."""
+    tr = Tracer(enabled=True)
+    with tr.span("ledger.close", ledger=3) as root3:
+        with tr.span("ledger.close.commit", parent=tr.current_id(),
+                     close_seq=3):
+            pass
+    rec3 = tr.commit_close(3, root3)
+    assert "ledger.close.commit" in [sp.name for sp in rec3.spans]
+
+
+def test_pipelined_tail_spans_land_in_their_close_record():
+    """End to end: with the pipeline overlapping (eager drain off), the
+    deferred commit/meta/gc spans of ledger N appear in trace?ledger=N
+    and nowhere else — proving the overlap is observable per ledger."""
+    app = make_app(PIPELINED_CLOSE=True,
+                   PIPELINED_CLOSE_EAGER_DRAIN=False)
+    seqs = [app.herder.manual_close() for _ in range(3)]
+    app.ledger_manager.pipeline.drain()
+    handler = CommandHandler(app)
+    for seq in seqs:
+        code, body = handler.handle("trace", {"ledger": str(seq)})
+        assert code == 200
+        trace = json.loads(body.data.decode())
+        names = [e["name"] for e in trace["traceEvents"]]
+        for tail_name in ("ledger.close.commit", "ledger.close.meta",
+                          "ledger.close.gc"):
+            assert names.count(tail_name) == 1, (seq, tail_name, names)
+        # tail spans parent into THIS close's root
+        by_id = {e["args"]["span_id"]: e for e in trace["traceEvents"]}
+        root_ids = {e["args"]["span_id"] for e in trace["traceEvents"]
+                    if e["name"] == "ledger.close"}
+        commit_ev = next(e for e in trace["traceEvents"]
+                         if e["name"] == "ledger.close.commit")
+        assert commit_ev["args"]["parent_id"] in root_ids
+        assert by_id[commit_ev["args"]["parent_id"]]["tid"] != \
+            commit_ev["tid"], "tail must run on the worker thread"
+    app.graceful_stop()

@@ -31,7 +31,9 @@ Usage: python tools/verify_green.py            -> exit 0 iff green
        --skip-parallel-smoke / --parallel-smoke-only control the second
            pass; --skip-chaos-smoke skips the chaos scenario smoke (one
            core-4 partition+heal run incl. the same-seed determinism
-           rerun, via tools/chaos_bench.py).
+           rerun, via tools/chaos_bench.py); --skip-pipeline-smoke
+           skips the PIPELINED_CLOSE=1 tier-1 rerun + the on/off
+           hash/meta parity mini-bench (tools/pipeline_bench.py).
 """
 import json
 import os
@@ -147,6 +149,95 @@ def run_parallel_smoke(cmd: str, native: bool = True) -> "tuple":
     return problems, passed, summary
 
 
+def run_pipelined_smoke(cmd: str) -> "tuple":
+    """The tier-1 line again with PIPELINED_CLOSE=1 exported: every
+    test Application closes through the pipelined engine (MANUAL_CLOSE
+    rigs eager-drain per close, so post-close reads keep sequential
+    semantics while the stage/tail/overlay machinery runs for real).
+    Afterwards a miniature tools/pipeline_bench.py run checks the
+    on/off hash+meta parity summary end to end.  Returns
+    (problems, passed, summary)."""
+    log_path = "/tmp/_t1p_pipeline.log"
+    smoke_cmd = cmd.replace("/tmp/_t1.log", log_path)
+    stats_path = "/tmp/_t1p_pipeline_stats.jsonl"
+    try:
+        os.unlink(stats_path)
+    except OSError:
+        pass
+    env = dict(os.environ)
+    env["PIPELINED_CLOSE"] = "1"
+    env["PIPELINED_CLOSE_STATS_FILE"] = stats_path
+    print(f"verify_green: [pipeline smoke] PIPELINED_CLOSE=1 "
+          f"{smoke_cmd}", flush=True)
+    proc = subprocess.run(["bash", "-c", smoke_cmd], cwd=REPO, env=env)
+    problems = []
+    if proc.returncode != 0:
+        problems.append(f"pipeline smoke exited {proc.returncode}")
+    try:
+        with open(log_path, errors="replace") as f:
+            log = f.read()
+    except OSError:
+        problems.append("pipeline smoke log missing")
+        log = ""
+    tail = "\n".join(log.splitlines()[-30:])
+    for pat, what in ((r"\b([1-9]\d*) failed\b", "failed tests"),
+                      (r"\b([1-9]\d*) errors?\b", "collection errors")):
+        m = re.search(pat, tail)
+        if m:
+            problems.append(f"pipeline smoke: {m.group(1)} {what}")
+    m = re.search(r"\b(\d+) passed\b", tail)
+    passed = m.group(1) if m else "?"
+    totals = {"sessions": 0, "tails": 0, "tail_failures": 0,
+              "prefetch_adopted": 0}
+    try:
+        with open(stats_path, errors="replace") as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                totals["sessions"] += 1
+                for k in ("tails", "tail_failures", "prefetch_adopted"):
+                    totals[k] += int(row.get(k, 0))
+    except OSError:
+        pass
+    if totals["tail_failures"]:
+        problems.append(
+            f"pipeline smoke: {totals['tail_failures']} tail failures")
+    # the on/off parity summary: a miniature bench run (2 closes/arm of
+    # 120 txs) whose parity pass compares per-close header/bucket
+    # hashes AND meta bytes pipeline-on vs off
+    bench_out = "/tmp/_t1p_pipeline_bench.json"
+    bench_env = dict(os.environ)
+    bench_env.update({"BENCH_CLOSES": "2", "BENCH_CLOSE_TXS": "120",
+                      "JAX_PLATFORMS": "cpu",
+                      "PIPELINE_BENCH_OUT": bench_out})
+    bench = subprocess.run(
+        [sys.executable, os.path.join("tools", "pipeline_bench.py")],
+        cwd=REPO, env=bench_env, capture_output=True, text=True)
+    parity = "unchecked"
+    if bench.returncode != 0:
+        problems.append("pipeline parity bench failed: "
+                        + "\n".join(bench.stderr.splitlines()[-3:]))
+        parity = "failed"
+    else:
+        try:
+            with open(bench_out) as f:
+                rep = json.load(f)["parity"]
+            parity = ("identical" if rep.get("hashes_identical")
+                      and rep.get("meta_bytes_identical") else "DIVERGED")
+            if parity == "DIVERGED":
+                problems.append("pipeline on/off hash parity DIVERGED")
+        except (OSError, ValueError, KeyError) as e:
+            problems.append(f"pipeline parity report unreadable: {e}")
+            parity = "unreadable"
+    summary = (f"{totals['tails']} tails over {totals['sessions']} app "
+               f"sessions, {totals['tail_failures']} tail failures, "
+               f"{totals['prefetch_adopted']} prefetched keys adopted, "
+               f"on/off parity {parity}")
+    return problems, passed, summary
+
+
 def run_chaos_smoke() -> "tuple":
     """One small chaos scenario end-to-end (core-4 partition+heal, with
     the same-seed determinism rerun): the full fault-inject -> heal ->
@@ -195,6 +286,7 @@ def main() -> int:
     skip_smoke = "--skip-parallel-smoke" in sys.argv
     skip_fallback = "--skip-fallback-smoke" in sys.argv
     skip_chaos = "--skip-chaos-smoke" in sys.argv
+    skip_pipeline = "--skip-pipeline-smoke" in sys.argv
     if smoke_only:
         cmd = tier1_command()
         problems, passed, summary = run_parallel_smoke(cmd)
@@ -263,6 +355,12 @@ def main() -> int:
                   flush=True)
             problems.extend(fb_problems)
             smoke_note += f", fallback smoke passed={fb_passed}"
+    if not skip_pipeline:
+        pl_problems, pl_passed, pl_summary = run_pipelined_smoke(cmd)
+        print(f"verify_green: pipelined-close smoke: {pl_summary}",
+              flush=True)
+        problems.extend(pl_problems)
+        smoke_note += f", pipeline smoke passed={pl_passed}"
     if not skip_chaos:
         chaos_problems, chaos_summary = run_chaos_smoke()
         print(f"verify_green: chaos smoke: {chaos_summary}", flush=True)
