@@ -188,12 +188,38 @@ class PendingEnvelopes:
         self.tx_sets: Dict[bytes, TxSetFrame] = {}
         self.qsets: Dict[bytes, object] = {}
         self.pending: Dict[bytes, List] = {}  # missing-hash -> envelopes
+        # tx-set hash -> the highest ledger seq known to reference it
+        # (the LCL at add, raised to any SCP slot whose statements name
+        # the hash): the retention key prune_below sweeps on.  Found by
+        # the r13 sustained-load soak: without pruning, a node under
+        # traffic retains EVERY proposal's TxSetFrame (frames,
+        # envelopes, signature caches) forever — the RSS slope the
+        # vitals sampler flagged (ref PendingEnvelopes::slotClosed
+        # discarding per closed slot).  Keying on the REFERENCING slot
+        # matters for a node that fell behind: a set fetched for a
+        # far-future slot must survive the catchup closes in between.
+        self._tx_set_seen: Dict[bytes, int] = {}
 
     def add_tx_set(self, tx_set: TxSetFrame) -> None:
         h = tx_set.contents_hash()
         self.tx_sets[h] = tx_set
-        for env in self.pending.pop(h, []):
+        seen = self.herder.app.ledger_manager.last_closed_seq()
+        waiting = self.pending.pop(h, [])
+        if waiting:
+            seen = max(seen, max(e.statement.slotIndex
+                                 for e in waiting))
+        if seen > self._tx_set_seen.get(h, -1):
+            self._tx_set_seen[h] = seen
+        for env in waiting:
             self.herder.deliver_ready_envelope(env)
+
+    def note_referenced(self, h: bytes, slot_index: int) -> None:
+        """Raise a held tx set's retention line to ``slot_index`` — a
+        live SCP slot still names it, so prune_below must not drop it
+        until that slot itself ages out."""
+        if h in self._tx_set_seen and \
+                slot_index > self._tx_set_seen[h]:
+            self._tx_set_seen[h] = slot_index
 
     def add_qset(self, qset) -> None:
         h = qset_hash(qset)
@@ -218,11 +244,33 @@ class PendingEnvelopes:
         for vh in _value_tx_set_hashes(st):
             if self.get_tx_set(vh) is None:
                 missing.append(vh)
+            else:
+                # already held: this statement's slot keeps it alive
+                self.note_referenced(vh, st.slotIndex)
         return missing
 
     def record_pending(self, env, missing: List[bytes]) -> None:
         for h in missing:
             self.pending.setdefault(h, []).append(env)
+
+    def prune_below(self, seq: int) -> int:
+        """Drop tx sets last relevant before ledger ``seq`` (the same
+        retention line the SCP slots use) and pending-fetch envelopes
+        for slots below it.  qsets stay: they dedup by hash across the
+        whole network and are few.  Returns tx sets dropped."""
+        stale = sorted(h for h, s in self._tx_set_seen.items()
+                       if s < seq)
+        for h in stale:
+            del self._tx_set_seen[h]
+            self.tx_sets.pop(h, None)
+        for h in sorted(self.pending):
+            kept = [e for e in self.pending[h]
+                    if e.statement.slotIndex >= seq]
+            if kept:
+                self.pending[h] = kept
+            else:
+                del self.pending[h]
+        return len(stale)
 
 
 def _value_tx_set_hashes(st) -> List[bytes]:
@@ -546,6 +594,8 @@ class Herder:
                 lcl_header.baseFee,
                 max_dex_ops=self.app.config.MAX_DEX_TX_OPERATIONS)
             self.pending_envelopes.add_tx_set(tx_set)
+            # lifecycle stage "txset": the tx made this proposal
+            self.app.txtracer.stamp_frames(tx_set.frames, "txset")
             lm.pipeline.adopt_prefetch(prefetch, lm.root)
             # plan the parallel apply of our own proposal NOW, off the
             # close's critical path; the close consumes the cached plan
@@ -565,6 +615,7 @@ class Herder:
 
         # single-node standalone networks externalize through the same SCP
         # slot (self-quorum makes the round instant)
+        self.app.txtracer.stamp_frames(tx_set.frames, "nominate")
         self.scp.nominate(slot, value, lcl_hash)
         if not self.app.config.MANUAL_CLOSE:
             self._arm_trigger()
@@ -590,6 +641,7 @@ class Herder:
         get_logger("SCP").debug(
             "externalized slot %d (%d txs, closeTime %d)",
             slot_index, tx_set.size(), sv.closeTime)
+        self.app.txtracer.stamp_frames(tx_set.frames, "externalize")
         back_in_sync = self.state != HerderState.TRACKING
         self.state = HerderState.TRACKING
         self._tracking_slot = slot_index
@@ -624,10 +676,13 @@ class Herder:
             # discard — both surfaced by the chaos stale_replay
             # scenario
             self.app.overlay_manager.floodgate.clear_below(slot_index)
-        self.scp.purge_slots(
-            max(0, slot_index - max(SCP_EXTRA_LOOKBACK_LEDGERS,
-                                    self.app.config.MAX_SLOTS_TO_REMEMBER)),
-            slot_index)
+        cutoff = max(0, slot_index - max(
+            SCP_EXTRA_LOOKBACK_LEDGERS,
+            self.app.config.MAX_SLOTS_TO_REMEMBER))
+        self.scp.purge_slots(cutoff, slot_index)
+        # tx sets age out on the same line the slots do (r13 soak: the
+        # unpruned map was the node's dominant RSS slope under load)
+        self.pending_envelopes.prune_below(cutoff)
 
     def check_quorum_intersection(self, qmap=None):
         """Run the quorum-intersection checker over the tracked network

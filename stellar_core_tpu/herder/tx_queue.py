@@ -43,8 +43,12 @@ class TransactionQueue:
 
     # -- admission ---------------------------------------------------------
 
-    def try_add(self, env) -> int:
-        """ref tryAdd :130 — the north-star admission path."""
+    def try_add(self, env, recv_ts=None) -> int:
+        """ref tryAdd :130 — the north-star admission path.
+
+        ``recv_ts``: overlay-receive timestamp token (a value from
+        ``app.txtracer.note_recv()``) so the lifecycle tracker's
+        recv->admit delta covers the decode/validity/signature cost."""
         network_id = self.app.config.network_id()
         try:
             frame = tx_frame_from_envelope(network_id, env)
@@ -98,6 +102,9 @@ class TransactionQueue:
         self.known[h] = frame
         self._ops_count += frame.num_operations()
         self.app.metrics.counter("herder.pending-txs.count").inc()
+        # lifecycle telemetry sampling gate (observational; the stamp's
+        # wallclock read lives in utils/txtrace.py)
+        self.app.txtracer.on_admit(h, recv_ts)
         return self.ADD_STATUS_PENDING
 
     # -- global size limiting (ref src/herder/TxQueueLimiter.h) ------------

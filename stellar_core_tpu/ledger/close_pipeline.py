@@ -211,6 +211,12 @@ class ClosePipeline:
     def drain(self) -> None:
         self.barrier()
 
+    def tail_depth(self) -> int:
+        """In-flight deferred tails (0 or 1 by the depth-1 contract) —
+        the vitals sampler's pipeline gauge."""
+        with self._lock:
+            return 0 if self._tail is None else 1
+
     def crash_abandon(self) -> None:
         """Crash semantics for tests: discard the in-flight tail WITHOUT
         letting it commit (the durable state stays at the last committed
@@ -355,6 +361,10 @@ def run_close_tail(app, st: StagedTail) -> None:
             db.commit()
         app.bucket_manager.gc_unreferenced(extra_live=st.live_hashes())
     tail_s["commit"] = sp.seconds
+    # lifecycle stage "commit", cross-close like the deferred spans:
+    # this runs DURING ledger N+1 but the stamp (and the completed
+    # record) belongs to the ORIGINATING ledger st.seq
+    app.txtracer.stamp_frames(st.apply_order, "commit", seq=st.seq)
     with tracer.span("ledger.close.meta", parent=st.parent_token,
                      close_seq=st.seq) as sp:
         hm = app.history_manager

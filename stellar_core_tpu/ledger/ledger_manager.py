@@ -293,6 +293,9 @@ class LedgerManager:
                         feeProcessing=fee_changes[i],
                         txApplyProcessing=meta))
             self._phase(phases, "apply", sp_apply.seconds)
+            # lifecycle stage "apply" (observational; the commit stamp
+            # lands later — on the tail worker under the pipeline)
+            self.app.txtracer.stamp_frames(apply_order, "apply")
             if planned and par.last_plan_stats:
                 phases["parallel"] = dict(
                     par.last_plan_stats,
@@ -450,6 +453,9 @@ class LedgerManager:
                 self._lcl_hash = xdr_sha256(T.LedgerHeader, new_header)
                 self._store_lcl(new_header)
                 self._store_bucket_state()
+                # lifecycle stage "commit": the ledger is durable
+                self.app.txtracer.stamp_frames(
+                    apply_order, "commit", seq=close_data.ledger_seq)
             self._phase(phases, "commit", sp_seal.seconds + sp.seconds)
         self.metrics.counter("ledger.ledger.count").set_count(
             new_header.ledgerSeq)

@@ -52,6 +52,16 @@ class Application:
                 if config.SLOW_CLOSE_THRESHOLD_SECONDS > 0 else None),
             trace_dir=config.TRACE_DIR,
             metrics=self.metrics)
+        # tx-lifecycle telemetry: sampled per-tx stage stamps across
+        # overlay/herder/ledger, rolled into txtrace.* histograms and
+        # the tx/latency endpoint (utils/txtrace.py)
+        from ..utils.txtrace import TxLifecycleTracker
+
+        self.txtracer = TxLifecycleTracker(
+            metrics=self.metrics,
+            enabled=config.TX_LIFECYCLE_TRACKING,
+            max_live=config.TX_LIFECYCLE_MAX_LIVE,
+            ring=config.TX_LIFECYCLE_RING)
         self.scheduler = Scheduler(clock)
         from ..database import Database
 
@@ -81,6 +91,12 @@ class Application:
         from ..catchup import CatchupManager
 
         self.catchup_manager = CatchupManager(self)
+        # continuous node-vitals sampler + SLO watchdog (utils/vitals):
+        # constructed always (endpoints/report work either way), the
+        # periodic timer + gc callback only engage via start()
+        from ..utils.vitals import VitalsSampler
+
+        self.vitals = VitalsSampler(self)
         self._meta_stream: List = []
         self._started = False
         # real-socket mode (enable_tcp): io service + listeners
@@ -144,6 +160,7 @@ class Application:
                                                     owner=self)
             self._arm_overlay_tick()
         self.history_manager.publish_queued_history()
+        self.vitals.start()
         self._started = True
 
     def _restore_bucket_state(self) -> bool:
@@ -279,7 +296,10 @@ class Application:
         Every timer tagged with this app is swept so no callback fires
         into freed subsystems; on-disk state (DATABASE file + bucket
         store) survives for a restart-from-state rebuild."""
-        # the close pipeline first: its tail worker holds the database
+        # vitals first: its gc callback is PROCESS-global (gc.callbacks)
+        # and must never keep timing collections for a dead node
+        self.vitals.stop()
+        # then the close pipeline: its tail worker holds the database
         # and bucket store, both torn down below (drains the in-flight
         # tail; an abandoned tail — the chaos pipeline-window crash —
         # was already discarded via crash_abandon)

@@ -261,6 +261,41 @@ class Config:
         self.ARTIFICIALLY_SLEEP_IN_CLOSE_FOR_TESTING: float = kw.get(
             "ARTIFICIALLY_SLEEP_IN_CLOSE_FOR_TESTING", 0.0)
 
+        # transaction-lifecycle telemetry (utils/txtrace.py): sampled
+        # per-tx stage stamps (overlay recv -> admit -> txset ->
+        # nominate -> externalize -> apply -> durable commit) rolled up
+        # into txtrace.* histograms and the HTTP tx/latency endpoint.
+        # Observational only — hashes/meta are bit-identical on or off
+        # (tests/test_txtrace.py) and the disabled cost is one attribute
+        # check per stamp site.
+        self.TX_LIFECYCLE_TRACKING: bool = kw.get(
+            "TX_LIFECYCLE_TRACKING", True)
+        # completed-lifecycle records retained for tx/latency
+        self.TX_LIFECYCLE_RING: int = kw.get("TX_LIFECYCLE_RING", 256)
+        # in-flight tracked txs before deterministic decimation halves
+        # the live map and doubles the sampling stride
+        self.TX_LIFECYCLE_MAX_LIVE: int = kw.get(
+            "TX_LIFECYCLE_MAX_LIVE", 512)
+
+        # continuous node-vitals sampler (utils/vitals.py): periodic
+        # RSS/fd/thread/queue/bucket/GC gauges in a bounded ring with
+        # per-gauge slope estimation, vitals.* Prometheus gauges, the
+        # HTTP vitals endpoint, and the SLO watchdog.  Suites and sims
+        # keep it off (one timer per node); real/TOML nodes default on.
+        self.VITALS_ENABLED: bool = kw.get("VITALS_ENABLED", True)
+        self.VITALS_PERIOD_SECONDS: float = kw.get(
+            "VITALS_PERIOD_SECONDS", 1.0)
+        self.VITALS_RING_SAMPLES: int = kw.get("VITALS_RING_SAMPLES", 900)
+        # append one JSON line per sample (offline soak analysis)
+        self.VITALS_JSONL: Optional[str] = kw.get("VITALS_JSONL")
+        # SLO ceilings the watchdog enforces (structured WARN per breach
+        # episode + slo.breach.* counters); each 0 disables that check
+        self.SLO_MAX_MEMORY_SLOPE_MB_S: float = kw.get(
+            "SLO_MAX_MEMORY_SLOPE_MB_S", 16.0)
+        self.SLO_MAX_CLOSE_P99_SECONDS: float = kw.get(
+            "SLO_MAX_CLOSE_P99_SECONDS", 5.0)
+        self.SLO_MAX_QUEUE_AGE: int = kw.get("SLO_MAX_QUEUE_AGE", 3)
+
         # invariants
         self.INVARIANT_CHECKS: List[str] = kw.get("INVARIANT_CHECKS", [])
 
@@ -303,6 +338,14 @@ class Config:
         if self.ARTIFICIALLY_SLEEP_IN_CLOSE_FOR_TESTING < 0:
             raise ConfigError(
                 "ARTIFICIALLY_SLEEP_IN_CLOSE_FOR_TESTING must be >= 0")
+        if self.VITALS_PERIOD_SECONDS <= 0:
+            raise ConfigError("VITALS_PERIOD_SECONDS must be > 0")
+        if self.VITALS_RING_SAMPLES < 2:
+            raise ConfigError("VITALS_RING_SAMPLES must be >= 2")
+        if self.TX_LIFECYCLE_RING < 1 or self.TX_LIFECYCLE_MAX_LIVE < 2:
+            raise ConfigError(
+                "TX_LIFECYCLE_RING must be >= 1 and "
+                "TX_LIFECYCLE_MAX_LIVE >= 2")
         if self.PARALLEL_APPLY_WORKERS < 0:
             raise ConfigError("PARALLEL_APPLY_WORKERS must be >= 0")
         if self.MAX_DEX_TX_OPERATIONS is not None and \
@@ -483,6 +526,12 @@ def test_config(n: int = 0, **kw) -> Config:
         # PIPELINED_CLOSE=1 smoke (MANUAL_CLOSE rigs then eager-drain
         # per close, so post-close reads keep sequential semantics)
         PIPELINED_CLOSE=os.environ.get("PIPELINED_CLOSE", "0") == "1",
+        # the vitals timer stays off in suites (a per-app 1 Hz timer
+        # would perturb crank_until-driven rigs and add 50 timers/s at
+        # simulation scale); vitals/soak tests opt in explicitly.  The
+        # tx-lifecycle tracker stays ON — it owns no timers and every
+        # suite close then exercises the stamp sites.
+        VITALS_ENABLED=False,
     )
     defaults.update(kw)
     return Config(**defaults)

@@ -5,7 +5,7 @@ embedded server).
 Single-threaded like the reference: a non-blocking listener on the app's
 TCPIOService, parsed with a minimal GET handler.  Routes: info, metrics,
 peers, quorum (?intersection=true), scp, tx?blob=<base64-xdr>,
-manualclose, ll?level=..., bans.
+manualclose, ll?level=..., bans, trace[/summary], tx/latency, vitals.
 """
 from __future__ import annotations
 
@@ -48,6 +48,8 @@ class CommandHandler:
             "generateload": self.generateload,
             "trace": self.trace,
             "trace/summary": self.trace_summary,
+            "tx/latency": self.tx_latency,
+            "vitals": self.vitals,
         }
 
     def handle(self, path: str, params: Dict[str, str]) -> tuple:
@@ -66,6 +68,20 @@ class CommandHandler:
         return 200, {"info": self.app.get_json_info()}
 
     def metrics(self, params):
+        # derived metrics registered IN the registry so the Prometheus
+        # exposition carries them too (they were JSON-side-table-only
+        # before): the root prefetch hit rate, the PR-9 footprint-
+        # prefetch hit rate, and the batched-kernel counter, which is
+        # pinned present from boot instead of appearing only after the
+        # first batched crossing
+        m = self.app.metrics
+        root = self.app.ledger_manager.root
+        pstats = self.app.ledger_manager.pipeline.stats
+        m.gauge("ledger.prefetch.hit-rate").set(root.prefetch_hit_rate())
+        m.gauge("ledger.close.prefetch.hit-rate").set(
+            pstats["prefetch_adopted"] / pstats["prefetch_keys"]
+            if pstats["prefetch_keys"] else 0.0)
+        m.counter("apply.native.batched_clusters")
         # ?format=prometheus: text exposition of the registry (plus the
         # flight recorder's span-derived timers, which live in the
         # registry as span.* Timers).  The default JSON body below is
@@ -77,9 +93,13 @@ class CommandHandler:
                 render_prometheus(self.app.metrics).encode(),
                 "text/plain; version=0.0.4; charset=utf-8")
         snap = self.app.metrics.snapshot()
-        root = self.app.ledger_manager.root
         snap["ledger.prefetch.hit-rate"] = round(
             root.prefetch_hit_rate(), 4)
+        # the close pipeline's session counters (tails, barrier wait,
+        # prefetch staging) at a glance, like bucket.merge.pipeline
+        snap["ledger.close.pipeline"] = {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in pstats.items()}
         # the async merge pipeline's health at a glance: per-phase ms of
         # the last close + cumulative staging counters (sync_fallback
         # _merges must read 0 in steady state)
@@ -365,6 +385,24 @@ class CommandHandler:
         return 200, RawBody(
             json.dumps(chrome_trace(rec), indent=1).encode(),
             "application/json")
+
+    def tx_latency(self, params):
+        """tx/latency?last=N — the transaction-lifecycle tracker's
+        report: per-stage + end-to-end latency summaries (ms) over the
+        sampled txs, tracker stats, and the last N completed
+        lifecycles (utils/txtrace.py)."""
+        last = int(params.get("last", "16"))
+        return 200, {"tx_latency": self.app.txtracer.report(last=last)}
+
+    def vitals(self, params):
+        """vitals — the node-vitals sampler's report: latest gauge
+        sample, per-gauge slopes over the ring, SLO watchdog state and
+        the GC pause histogram (utils/vitals.py).  ?sample=true takes
+        one sample on demand (works even when the periodic timer is
+        disabled — suites, sims)."""
+        if params.get("sample") == "true":
+            self.app.vitals.sample_once()
+        return 200, {"vitals": self.app.vitals.report()}
 
     def trace_summary(self, params):
         """trace/summary?k=N — top-k self-time spans aggregated over the

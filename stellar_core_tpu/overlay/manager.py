@@ -190,11 +190,14 @@ class OverlayManager:
 
     def recv_transaction(self, peer, env) -> None:
         with self.app.tracer.span("overlay.recv.transaction"):
+            # lifecycle stage "recv": stamp token captured BEFORE the
+            # admission work so recv->admit covers decode+validity+sigs
+            recv_ts = self.app.txtracer.note_recv()
             msg = O.StellarMessage.make(O.MessageType.TRANSACTION, env)
             if not self.floodgate.add_record(msg, peer.peer_id,
                                              self._ledger_seq()):
                 return
-            res = self.app.herder.tx_queue.try_add(env)
+            res = self.app.herder.tx_queue.try_add(env, recv_ts=recv_ts)
             if res == 0:  # pending: forward
                 self.broadcast_message(msg)
 

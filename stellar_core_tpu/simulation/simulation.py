@@ -77,8 +77,14 @@ class Simulation:
         # in one process would own 50 tail workers for no modelled
         # benefit, and the scripted chaos wall-cost budget predates it.
         # Pipeline-specific sim tests (the chaos pipeline-window
-        # kill-restore) opt in per node via config_kw.
+        # kill-restore) opt in per node via config_kw, and the core-4
+        # chaos smoke tier runs PIPELINED_CLOSE=True wholesale
+        # (tools/chaos_bench.py) so the overlap contract is
+        # chaos-tested.
         config_kw.setdefault("PIPELINED_CLOSE", False)
+        # no per-node 1 Hz vitals timers at simulation scale (50 nodes
+        # = 50 timers per virtual second); vitals tests opt in
+        config_kw.setdefault("VITALS_ENABLED", False)
         return Config(
             NETWORK_PASSPHRASE=self.network_passphrase,
             NODE_SEED=recipe["seed"],

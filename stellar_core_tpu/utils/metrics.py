@@ -26,6 +26,18 @@ class Counter:
         self.count = n
 
 
+class Gauge:
+    """Last-write instantaneous value (vitals samples, derived rates).
+    Unlike medida's callback gauges this is push-style: the owner sets
+    it when it samples, so reading a snapshot never runs foreign code."""
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v: float):
+        self.value = float(v)
+
+
 class Meter:
     """Event rate tracker (1m EWMA + total count).
 
@@ -152,13 +164,26 @@ class _TimeScope:
 
 class MetricsRegistry:
     def __init__(self, clock=None):
+        import threading
+
         self._clock = clock
         self._metrics: Dict[str, object] = {}
+        # registration is the one cross-thread mutation (the pipelined
+        # close's tail worker and gc callbacks both register lazily):
+        # without the lock, two threads racing the get-then-insert
+        # below could each create the metric and one would silently
+        # lose its updates.  Reads stay lock-free: iteration always
+        # goes through sorted(...) whose list materialization is
+        # GIL-atomic.
+        self._reg_lock = threading.Lock()
 
     def _get(self, name: str, cls, *args):
         m = self._metrics.get(name)
         if m is None:
-            m = self._metrics[name] = cls(*args)
+            with self._reg_lock:
+                m = self._metrics.get(name)
+                if m is None:
+                    m = self._metrics[name] = cls(*args)
         assert isinstance(m, cls), f"{name} registered as {type(m).__name__}"
         return m
 
@@ -174,11 +199,16 @@ class MetricsRegistry:
     def histogram(self, name: str) -> Histogram:
         return self._get(name, Histogram)
 
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
     def snapshot(self) -> dict:
         out = {}
         for name, m in sorted(self._metrics.items()):
             if isinstance(m, Counter):
                 out[name] = {"type": "counter", "count": m.count}
+            elif isinstance(m, Gauge):
+                out[name] = {"type": "gauge", "value": m.value}
             elif isinstance(m, Timer):
                 out[name] = {"type": "timer", **m.summary(),
                              "rate1m": m.meter.one_minute_rate}
@@ -219,6 +249,9 @@ def render_prometheus(registry: MetricsRegistry) -> str:
         if isinstance(m, Counter):
             lines.append(f"# TYPE {pname} counter")
             lines.append(f"{pname} {m.count}")
+        elif isinstance(m, Gauge):
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname} {m.value:.6g}")
         elif isinstance(m, Timer):
             _render_summary(lines, pname + "_seconds", m)
             rname = pname + "_rate1m"

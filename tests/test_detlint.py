@@ -970,3 +970,68 @@ def test_changed_mode_parity_on_a_tree_with_findings(tmp_path):
     assert [f.render() for f in cold] \
         == [f.render() for f in warm_cold] \
         == [f.render() for f in warm]
+
+
+def test_txtrace_vitals_sanctioned_observation_only():
+    """ISSUE 12 satellite: utils/txtrace.py and utils/vitals.py hold
+    the lifecycle/vitals wallclock reads and are sanctioned like
+    tracing.py — not taint sources AND cut as carriers — while the
+    identical helper inside a consensus dir still fires the taint rule
+    (proving the sanction, not the depth bound, is load-bearing)."""
+    from tools.lint.callgraph import SANCTIONED_MODULES
+
+    TXTRACE = "stellar_core_tpu/utils/txtrace.py"
+    VITALS = "stellar_core_tpu/utils/vitals.py"
+    assert TXTRACE in SANCTIONED_MODULES
+    assert VITALS in SANCTIONED_MODULES
+
+    helper = '''
+import time
+
+
+def stamp():
+    return time.time()
+'''
+    sink = '''
+from ..utils.txtrace import stamp
+
+
+def vote_hash(values):
+    import hashlib
+    h = hashlib.sha256()
+    for v in values:
+        h.update(v + bytes([int(stamp()) % 7]))
+    return h.digest()
+'''
+    # a wallclock read INSIDE txtrace.py is observation-only: no chain
+    findings = lint_sources({TXTRACE: helper, SCP_SINK: sink})
+    assert not [f for f in findings
+                if f.rule == "det-interproc-taint"], \
+        [f.render() for f in findings]
+
+    # the SAME helper in scp/ is a live source: the sanction cut it
+    sink_scp = sink.replace("from ..utils.txtrace import stamp",
+                            "from .injected_helpers import stamp")
+    findings = lint_sources({SCP_HELPER: helper, SCP_SINK: sink_scp})
+    hits = [f for f in findings if f.rule == "det-interproc-taint"]
+    assert hits, [f.render() for f in findings]
+    assert "wallclock time.time()" in hits[0].message
+
+    # carrier laundering is cut too: a consensus source wrapped by a
+    # txtrace function never reaches a consensus sink as a chain (the
+    # documented sanctioned-module blind spot, now pinned for txtrace)
+    carrier = '''
+from ..scp.injected_helpers import stamp
+
+
+def wrap():
+    return stamp()
+'''
+    sink_carrier = sink.replace(
+        "from ..utils.txtrace import stamp",
+        "from ..utils.txtrace import wrap").replace("stamp()", "wrap()")
+    findings = lint_sources({SCP_HELPER: helper, TXTRACE: carrier,
+                             SCP_SINK: sink_carrier})
+    assert not [f for f in findings
+                if f.rule == "det-interproc-taint"], \
+        [f.render() for f in findings]

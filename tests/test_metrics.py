@@ -182,3 +182,47 @@ def test_prometheus_name_sanitization():
     reg.counter("bucket.merge.sync-fallback").inc(3)
     samples, _ = _parse(render_prometheus(reg))
     assert samples["bucket_merge_sync_fallback"][""] == 3
+
+
+# ---------------------------------------------------------------------------
+# gauges + derived-rate exposition (ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+
+def test_gauge_snapshot_and_prometheus():
+    reg = MetricsRegistry()
+    reg.gauge("vitals.rss_bytes").set(123456)
+    reg.gauge("ledger.prefetch.hit-rate").set(0.75)
+    snap = reg.snapshot()
+    assert snap["vitals.rss_bytes"] == {"type": "gauge",
+                                        "value": 123456.0}
+    samples, types = _parse(render_prometheus(reg))
+    assert types["vitals_rss_bytes"] == "gauge"
+    assert samples["vitals_rss_bytes"][""] == 123456.0
+    assert samples["ledger_prefetch_hit_rate"][""] == 0.75
+    # re-registering under a different type stays a loud assert
+    with pytest.raises(AssertionError):
+        reg.counter("vitals.rss_bytes")
+
+
+def test_every_rate1m_sample_has_a_gauge_type_line():
+    """Every derived one-minute-rate sample (Meter AND Timer) must be
+    preceded by its own `# TYPE ... gauge` declaration — a rate sample
+    without one inherits the neighboring counter/summary type in strict
+    Prometheus parsers."""
+    clk = FakeClock()
+    reg = MetricsRegistry(clk)
+    m = reg.meter("overlay.message.read")
+    clk.t += 1.0
+    m.mark(3)
+    t = reg.timer("ledger.ledger.close")
+    clk.t += 1.0
+    t.update(0.02)
+    lines = render_prometheus(reg).splitlines()
+    declared = {ln.split()[2]: ln.split()[3] for ln in lines
+                if ln.startswith("# TYPE ")}
+    rate_names = [ln.split()[0] for ln in lines
+                  if not ln.startswith("#") and
+                  ln.split()[0].endswith("_rate1m")]
+    assert len(rate_names) == 2  # one per meter, one per timer
+    for name in rate_names:
+        assert declared.get(name) == "gauge", (name, declared)

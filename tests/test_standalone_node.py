@@ -251,3 +251,64 @@ def test_queue_limiter_evicts_cheapest(app):
     assert q.size() == 2  # c1 + c2 both intact
     c2f = tx_frame_from_envelope(app.config.network_id(), c2)
     assert not q.is_banned(c2f.full_hash())
+
+
+def test_tx_set_retention_bounded(app):
+    """r13 soak finding: every close adds its proposal's TxSetFrame to
+    PendingEnvelopes, and nothing pruned the map — a node under
+    sustained traffic leaked one full tx set per ledger forever.  Tx
+    sets now age out on the SCP slot-retention line."""
+    from stellar_core_tpu.herder.herder import SCP_EXTRA_LOOKBACK_LEDGERS
+
+    pe = app.herder.pending_envelopes
+    for _ in range(20):
+        app.herder.manual_close()
+    window = max(SCP_EXTRA_LOOKBACK_LEDGERS,
+                 app.config.MAX_SLOTS_TO_REMEMBER)
+    assert len(pe.tx_sets) <= window + 1, len(pe.tx_sets)
+    assert len(pe._tx_set_seen) == len(pe.tx_sets)
+    assert pe.pending == {}
+
+
+def test_tx_set_retention_follows_referencing_slot(app):
+    """Review hardening on the r13 pruning: a tx set fetched for a
+    FAR-FUTURE slot while the node is behind must survive the catchup
+    closes in between — retention keys on the highest referencing
+    slot, not the LCL when the set arrived (else value_externalized
+    would crash on 'externalized value with unknown tx set')."""
+    from types import SimpleNamespace
+
+    from stellar_core_tpu.herder.herder import SCP_EXTRA_LOOKBACK_LEDGERS
+    from stellar_core_tpu.herder.tx_set import TxSetFrame
+
+    pe = app.herder.pending_envelopes
+    lm = app.ledger_manager
+    future_slot = lm.last_closed_seq() + 40
+
+    # a pending envelope for the future slot is waiting on the fetch
+    ts = TxSetFrame(app.config.network_id(), lm.last_closed_hash(), [])
+    h = ts.contents_hash()
+    pe.pending[h] = []  # fetch outstanding, no deliverable envelopes
+    pe.add_tx_set(ts)
+    pe.note_referenced(h, future_slot)  # a slot statement names it
+
+    window = max(SCP_EXTRA_LOOKBACK_LEDGERS,
+                 app.config.MAX_SLOTS_TO_REMEMBER)
+    # catchup-era pruning between now and the future slot keeps it
+    pe.prune_below(future_slot - 5)
+    assert h in pe.tx_sets
+    # ...and it ages out once the referencing slot itself is purged
+    pe.prune_below(future_slot + window)
+    assert h not in pe.tx_sets
+
+    # the add path itself absorbs waiting envelopes' slots
+    ts2 = TxSetFrame(app.config.network_id(), b"\x01" * 32, [])
+    h2 = ts2.contents_hash()
+    env = SimpleNamespace(statement=SimpleNamespace(
+        slotIndex=future_slot, nodeID=None))
+    pe.pending[h2] = [env]
+    delivered = []
+    app.herder.deliver_ready_envelope = lambda e: delivered.append(e)
+    pe.add_tx_set(ts2)
+    assert delivered == [env]
+    assert pe._tx_set_seen[h2] == future_slot

@@ -1,0 +1,331 @@
+"""Transaction-lifecycle telemetry tests (ISSUE 12 tentpole part 1).
+
+The tracker follows sampled txs across subsystems (overlay recv ->
+admit -> txset -> nominate -> externalize -> apply -> durable commit).
+It is OBSERVATIONAL: ledger/bucket hashes AND meta bytes must be
+bit-identical with tracking on vs off, under PIPELINED_CLOSE on/off and
+under PYTHONHASHSEED variation; sampling must be a deterministic
+function of the admission sequence (stride decimation, never hash order
+or a PRNG); and the pipelined tail's commit stamp must land on the
+ORIGINATING ledger even though it runs during the next one.
+"""
+import json
+import os
+import subprocess
+import sys
+
+from stellar_core_tpu.main import Application, test_config
+from stellar_core_tpu.main.http_server import CommandHandler
+from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+from stellar_core_tpu.utils.txtrace import STAGES, TxLifecycleTracker
+from stellar_core_tpu.xdr import types as T
+
+
+class _Frame:
+    """Minimal frame stub: the tracker only calls full_hash()."""
+
+    def __init__(self, h: bytes):
+        self._h = h
+
+    def full_hash(self) -> bytes:
+        return self._h
+
+
+def _hashes(n):
+    return [b"%032d" % i for i in range(n)]
+
+
+# -- unit: sampling + bounding ----------------------------------------------
+
+def test_stride_decimation_deterministic_and_bounded():
+    """Which txs get tracked is a pure function of the admission
+    sequence; the live map never exceeds max_live and the stride
+    doubles on every decimation (the PR-4 Histogram discipline)."""
+    def run():
+        tr = TxLifecycleTracker(max_live=16, ring=8)
+        for h in _hashes(300):
+            tr.on_admit(h)
+        return list(tr._live), tr._stride, tr.stats()
+
+    live_a, stride_a, stats_a = run()
+    live_b, stride_b, stats_b = run()
+    assert live_a == live_b and stride_a == stride_b
+    assert stats_a == stats_b
+    assert len(live_a) <= 16
+    assert stride_a >= 2 and stats_a["decimations"] >= 1
+    assert stats_a["seen"] == 300
+
+
+def test_completed_ring_is_bounded():
+    tr = TxLifecycleTracker(max_live=64, ring=4)
+    for h in _hashes(20):
+        tr.on_admit(h)
+        tr.stamp_frames([_Frame(h)], "apply")
+        tr.stamp_frames([_Frame(h)], "commit", seq=7)
+    assert tr.stats()["completed"] == 20
+    assert len(tr._ring) == 4  # ring kept the LAST 4 only
+
+
+def test_disabled_and_untracked_stamps_are_noops():
+    tr = TxLifecycleTracker(enabled=False)
+    tr.on_admit(b"x" * 32)
+    tr.stamp_frames([_Frame(b"x" * 32)], "commit", seq=1)
+    assert tr.stats()["seen"] == 0 and tr.stats()["completed"] == 0
+    tr2 = TxLifecycleTracker()
+    # never admitted -> every stamp is a dict-probe no-op
+    tr2.stamp_frames([_Frame(b"y" * 32)], "apply")
+    tr2.stamp_frames([_Frame(b"y" * 32)], "commit", seq=1)
+    assert tr2.stats()["completed"] == 0
+
+
+def test_stage_deltas_skip_missing_stages():
+    """A tx that entered via a peer-proposed set has no txset/nominate
+    stamps; deltas pair only the PRESENT stages."""
+    tr = TxLifecycleTracker()
+    h = b"z" * 32
+    tr.on_admit(h)
+    f = _Frame(h)
+    tr.stamp_frames([f], "externalize")
+    tr.stamp_frames([f], "apply")
+    tr.stamp_frames([f], "commit", seq=3)
+    names = sorted(n for n in tr.metrics._metrics
+                   if n.startswith("txtrace.stage."))
+    assert names == ["txtrace.stage.admit_to_externalize",
+                     "txtrace.stage.apply_to_commit",
+                     "txtrace.stage.externalize_to_apply"]
+    rec = tr.report()["recent"][-1]
+    assert rec["ledger"] == 3
+    ms = rec["stages_ms"]
+    assert ms["admit"] <= ms["externalize"] <= ms["apply"] <= ms["commit"]
+
+
+# -- through the real node ---------------------------------------------------
+
+def _mk_app(**kw):
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), test_config(
+        TESTING_UPGRADE_MAX_TX_SET_SIZE=200, **kw))
+    app.start()
+    return app
+
+
+def test_lifecycle_through_real_closes():
+    app = _mk_app()
+    handler = CommandHandler(app)
+    code, body = handler.handle("generateload",
+                                {"mode": "create", "accounts": "16"})
+    assert code == 200, body
+    app.herder.manual_close()
+    code, body = handler.handle("generateload",
+                                {"mode": "pay", "txs": "32"})
+    assert code == 200, body
+    app.herder.manual_close()
+    rep = app.txtracer.report()
+    assert rep["completed"] >= 32
+    rec = rep["recent"][-1]
+    assert rec["ledger"] == app.ledger_manager.last_closed_seq()
+    ms = rec["stages_ms"]
+    # the full self-proposed pipeline, stamps in monotonic order
+    for a, b in zip(("admit", "txset", "nominate", "externalize",
+                     "apply", "commit"),
+                    ("txset", "nominate", "externalize", "apply",
+                     "commit", "commit")):
+        assert ms[a] <= ms[b], (a, b, ms)
+    assert rep["latency"]["txtrace.e2e.admit_to_commit"]["count"] >= 32
+    app.graceful_stop()
+
+
+def test_pipelined_commit_stamp_lands_on_originating_ledger():
+    """The PR-9 cross-close discipline: with the tail genuinely
+    overlapping (eager drain off), the commit stamp runs during ledger
+    N+1 but the completed record carries N."""
+    app = _mk_app(PIPELINED_CLOSE=True, PIPELINED_CLOSE_EAGER_DRAIN=False)
+    handler = CommandHandler(app)
+    code, body = handler.handle("generateload",
+                                {"mode": "create", "accounts": "12"})
+    assert code == 200, body
+    app.herder.manual_close()
+    seqs = []
+    for _ in range(3):
+        code, body = handler.handle("generateload",
+                                    {"mode": "pay", "txs": "12"})
+        assert code == 200, body
+        app.herder.manual_close()
+        seqs.append(app.ledger_manager.last_closed_seq())
+    app.ledger_manager.pipeline.drain()
+    rep = app.txtracer.report(last=64)
+    got = {r["ledger"] for r in rep["recent"]}
+    assert set(seqs) <= got, (seqs, got)
+    assert app.ledger_manager.pipeline.stats["tails"] >= 3
+    app.graceful_stop()
+
+
+def test_overlay_recv_stamp_feeds_recv_to_commit():
+    """A tx arriving via the overlay path gets the recv stage; the
+    recv->admit and recv->commit rollups appear."""
+    from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+    from stellar_core_tpu.simulation.simulation import pair
+
+    sim = pair()
+    sim.start_all_nodes()
+    assert sim.close_ledger()
+    a, b = list(sim.nodes.values())
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+
+    lg = LoadGenerator(a)
+    root_env = lg.create_account_envelopes(4)
+    for env in root_env:
+        assert a.herder.recv_transaction(env) == 0
+
+    def _accounts_exist():
+        with LedgerTxn(a.ledger_manager.root) as ltx:
+            e = ltx.load_account(lg.accounts[0].public_key().raw)
+            ltx.rollback()
+        return e is not None
+
+    # the round leader may pick the other node's (empty) proposal, so
+    # a queued tx can take an extra round to land
+    for _ in range(4):
+        assert sim.close_ledger()
+        if _accounts_exist():
+            break
+    assert _accounts_exist()
+    # pay txs flood a -> b; b's tracker sees them via overlay recv
+    envs = lg.generate_payments(8)
+    for env in envs:
+        assert a.herder.recv_transaction(env) == 0
+    for _ in range(4):
+        assert sim.close_ledger()
+        if b.txtracer.stats()["completed"] >= 1:
+            break
+    rep_b = b.txtracer.report()
+    assert rep_b["completed"] >= 1
+    assert "txtrace.e2e.recv_to_commit" in rep_b["latency"]
+    assert "txtrace.stage.recv_to_admit" in rep_b["latency"]
+    for app in sim.nodes.values():
+        app.stop_node()
+
+
+# -- observational bit-identity ----------------------------------------------
+
+def run_telemetry_workload(telemetry: bool, pipelined: bool = False,
+                           **kw):
+    """Deterministic mixed workload through the full close path with
+    the lifecycle tracker + vitals sampling on or off; returns per-close
+    (ledger hash, bucket hash, meta bytes).  Shared with
+    tools/soak_bench.py's parity pass."""
+    app = _mk_app(
+        TX_LIFECYCLE_TRACKING=telemetry,
+        PIPELINED_CLOSE=pipelined,
+        PIPELINED_CLOSE_EAGER_DRAIN=False if pipelined else None,
+        **kw)
+    handler = CommandHandler(app)
+    out = []
+
+    def close():
+        if telemetry:
+            app.vitals.sample_once()
+        app.herder.manual_close()
+        out.append((app.ledger_manager.last_closed_hash(),
+                    app.bucket_manager.get_bucket_list_hash()))
+
+    code, body = handler.handle("generateload",
+                                {"mode": "create", "accounts": "20"})
+    assert code == 200, body
+    close()
+    for _ in range(2):  # issuer, trustlines, funding
+        code, body = handler.handle("generateload",
+                                    {"mode": "mixed", "txs": "40"})
+        assert code == 200, body
+        close()
+    for _ in range(3):
+        code, body = handler.handle(
+            "generateload", {"mode": "mixed", "txs": "40",
+                             "dexpct": "40"})
+        assert code == 200, body
+        close()
+    app.ledger_manager.pipeline.drain()
+    metas = [T.LedgerCloseMeta.encode(m) for m in app._meta_stream]
+    app.graceful_stop()
+    assert len(metas) == len(out)
+    return [h + (m,) for h, m in zip(out, metas)]
+
+
+def _assert_identical(a, b, label):
+    assert len(a) == len(b)
+    for i, (ra, rb) in enumerate(zip(a, b)):
+        assert ra[0] == rb[0], f"[{label}] ledger hash diverged @ {i}"
+        assert ra[1] == rb[1], f"[{label}] bucket hash diverged @ {i}"
+        assert ra[2] == rb[2], f"[{label}] meta bytes diverged @ {i}"
+
+
+def test_hashes_and_meta_identical_telemetry_on_off():
+    """The acceptance gate: stamps are observational — bytes identical
+    with telemetry on vs off, sequential AND pipelined close."""
+    base_on = run_telemetry_workload(True)
+    base_off = run_telemetry_workload(False)
+    _assert_identical(base_on, base_off, "sequential")
+    pipe_on = run_telemetry_workload(True, pipelined=True)
+    pipe_off = run_telemetry_workload(False, pipelined=True)
+    _assert_identical(pipe_on, pipe_off, "pipelined")
+    # and the pipeline itself stays bit-identical with telemetry on
+    _assert_identical(base_on, pipe_on, "seq-vs-pipe")
+
+
+_HASHSEED_WORKER = """
+import hashlib
+import sys
+
+sys.path.insert(0, {repo!r})
+from tests.test_txtrace import run_telemetry_workload
+
+for lh, bh, meta in run_telemetry_workload(True, pipelined=True):
+    print(lh.hex(), bh.hex(), hashlib.sha256(meta).hexdigest())
+"""
+
+
+def test_telemetry_bit_stable_under_hashseed_variation():
+    """PYTHONHASHSEED 0 vs 4242 with telemetry ON and the pipeline ON:
+    every per-close fingerprint must match — tracking must not smuggle
+    hash-order anywhere consensus-visible."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    outputs = []
+    for seed in ("0", "4242"):
+        env = dict(os.environ)
+        env["PYTHONHASHSEED"] = seed
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "-c", _HASHSEED_WORKER.format(repo=repo)],
+            capture_output=True, text=True, cwd=repo, env=env,
+            timeout=600)
+        assert proc.returncode == 0, proc.stderr[-4000:]
+        lines = proc.stdout.strip().splitlines()
+        assert len(lines) >= 6, proc.stdout
+        outputs.append(lines)
+    a, b = outputs
+    assert a == b, "telemetry-on close fingerprints diverged across " \
+                   "PYTHONHASHSEED values"
+
+
+# -- endpoint ---------------------------------------------------------------
+
+def test_tx_latency_endpoint_roundtrip():
+    app = _mk_app()
+    handler = CommandHandler(app)
+    code, body = handler.handle("generateload",
+                                {"mode": "create", "accounts": "8"})
+    assert code == 200, body
+    app.herder.manual_close()
+    code, body = handler.handle("tx/latency", {"last": "4"})
+    assert code == 200
+    rep = body["tx_latency"]
+    assert rep["enabled"] is True and rep["completed"] >= 1
+    assert len(rep["recent"]) <= 4
+    for s in rep["latency"].values():
+        assert set(s) == {"count", "p50_ms", "p99_ms", "mean_ms",
+                          "max_ms"}
+    json.dumps(body)  # the HTTP layer serializes this verbatim
+    # prometheus exposition carries the same histograms
+    code, prom = handler.handle("metrics", {"format": "prometheus"})
+    assert code == 200
+    assert "txtrace_e2e_admit_to_commit" in prom.data.decode()
+    app.graceful_stop()

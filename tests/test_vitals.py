@@ -1,0 +1,181 @@
+"""Node-vitals sampler tests (ISSUE 12 tentpole part 2): bounded ring,
+exact slope math, GC-pause capture via gc.callbacks (registered AND
+unregistered — the callback is process-global), SLO watchdog edges,
+and the vitals endpoint / Prometheus gauge surfaces.
+"""
+import gc
+import json
+
+from stellar_core_tpu.main import Application, test_config
+from stellar_core_tpu.main.http_server import CommandHandler
+from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+from stellar_core_tpu.utils.vitals import least_squares_slope
+
+
+def _mk_app(**kw):
+    kw.setdefault("VITALS_ENABLED", True)
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME),
+                      test_config(**kw))
+    app.start()
+    return app
+
+
+# -- unit --------------------------------------------------------------------
+
+def test_least_squares_slope_exact():
+    assert least_squares_slope([]) == 0.0
+    assert least_squares_slope([(0.0, 5.0)]) == 0.0
+    # v = 3t + 1 exactly
+    pts = [(float(t), 3.0 * t + 1.0) for t in range(10)]
+    assert abs(least_squares_slope(pts) - 3.0) < 1e-9
+    # flat series -> 0, degenerate time axis -> 0
+    assert least_squares_slope([(1.0, 7.0), (2.0, 7.0)]) == 0.0
+    assert least_squares_slope([(1.0, 1.0), (1.0, 9.0)]) == 0.0
+
+
+def test_sample_ring_bounded_with_expected_gauges():
+    app = _mk_app(VITALS_RING_SAMPLES=5)
+    for _ in range(12):
+        sample = app.vitals.sample_once()
+    assert len(app.vitals.ring) == 5  # bounded
+    assert app.vitals.samples_taken == 12
+    expected = {"t", "rss_bytes", "open_fds", "threads",
+                "tx_queue_depth", "tx_queue_age_max",
+                "pipeline_tail_depth", "bucket_entries",
+                "bucket_disk_bytes", "verify_cache_hit_rate",
+                "prefetch_hit_rate", "gc_pending"}
+    assert set(sample) == expected
+    assert sample["rss_bytes"] > 0 and sample["threads"] >= 1
+    # every numeric gauge mirrored into the registry
+    for k in expected - {"t"}:
+        assert app.metrics._metrics[f"vitals.{k}"].value == sample[k]
+    app.graceful_stop()
+
+
+def test_periodic_timer_populates_ring_on_crank():
+    app = _mk_app(VITALS_PERIOD_SECONDS=0.5)
+    app.clock.crank_until(lambda: len(app.vitals.ring) >= 4, timeout=10)
+    assert len(app.vitals.ring) >= 4
+    app.graceful_stop()
+
+
+def test_gc_pause_recorded_and_callback_unregistered():
+    app = _mk_app()
+    n0 = len(gc.callbacks)
+    gc.collect()
+    h = app.metrics._metrics.get("vitals.gc.pause")
+    assert h is not None and h.count >= 1
+    assert app.metrics.counter("vitals.gc.gen2.collections").count >= 1
+    app.graceful_stop()
+    # process-global callback list back to its pre-node population
+    assert len(gc.callbacks) == n0 - 1
+    assert app.vitals._on_gc not in gc.callbacks
+
+
+def test_jsonl_persistence(tmp_path):
+    path = str(tmp_path / "vitals.jsonl")
+    app = _mk_app(VITALS_JSONL=path)
+    for _ in range(3):
+        app.vitals.sample_once()
+    lines = open(path).read().strip().splitlines()
+    assert len(lines) == 3
+    row = json.loads(lines[-1])
+    assert row["rss_bytes"] > 0
+    app.graceful_stop()
+
+
+# -- SLO watchdog ------------------------------------------------------------
+
+def _synthetic_sample(t, rss, age=0):
+    return {"t": float(t), "rss_bytes": float(rss), "open_fds": 10,
+            "threads": 2, "tx_queue_depth": 0, "tx_queue_age_max": age,
+            "pipeline_tail_depth": 0, "bucket_entries": 0,
+            "bucket_disk_bytes": 0, "verify_cache_hit_rate": 0.0,
+            "prefetch_hit_rate": 0.0, "gc_pending": 0}
+
+
+def test_slo_memory_slope_breach_counts_and_warns_once_per_episode():
+    app = _mk_app(SLO_MAX_MEMORY_SLOPE_MB_S=1.0)
+    v = app.vitals
+    # 10 MB/s synthetic growth over 2x warmup samples (the slope SLO
+    # fits the newest HALF, so sustained growth must still trip it)
+    for t in range(20):
+        v.ring.append(_synthetic_sample(t, 100e6 + t * 10e6))
+    v._check_slos(v.ring[-1])
+    v._check_slos(v.ring[-1])
+    assert app.metrics.counter("slo.breach.memory-slope").count == 2
+    assert v._slo_active["memory-slope"] is True
+    # flat series ends the episode
+    v.ring.clear()
+    for t in range(20):
+        v.ring.append(_synthetic_sample(t, 100e6))
+    v._check_slos(v.ring[-1])
+    assert v._slo_active["memory-slope"] is False
+    assert app.metrics.counter("slo.breach.memory-slope").count == 2
+    # a startup transient followed by flat steady state must NOT breach
+    # (the tail fit excludes the fill phase)
+    v.ring.clear()
+    for t in range(20):
+        rss = 100e6 + (t * 50e6 if t < 8 else 8 * 50e6)
+        v.ring.append(_synthetic_sample(t, rss))
+    v._check_slos(v.ring[-1])
+    assert app.metrics.counter("slo.breach.memory-slope").count == 2
+    app.graceful_stop()
+
+
+def test_slo_queue_age_and_close_p99():
+    app = _mk_app(SLO_MAX_QUEUE_AGE=2, SLO_MAX_CLOSE_P99_SECONDS=0.001)
+    v = app.vitals
+    v._check_slos(_synthetic_sample(0, 1e6, age=3))
+    assert app.metrics.counter("slo.breach.queue-age").count == 1
+    # close-p99: needs warmup count on the ledger close timer
+    t = app.metrics.timer("ledger.ledger.close")
+    for _ in range(8):
+        t.update(0.5)  # 500ms >> the 1ms ceiling
+    v._check_slos(_synthetic_sample(1, 1e6))
+    assert app.metrics.counter("slo.breach.close-p99").count == 1
+    rep = v.report()
+    assert rep["slo"]["breaches"]["queue-age"] == 1
+    assert rep["slo"]["breaches"]["close-p99"] == 1
+    app.graceful_stop()
+
+
+def test_slo_disabled_by_zero_ceilings():
+    app = _mk_app(SLO_MAX_MEMORY_SLOPE_MB_S=0.0,
+                  SLO_MAX_CLOSE_P99_SECONDS=0.0, SLO_MAX_QUEUE_AGE=0)
+    v = app.vitals
+    for t in range(10):
+        v.ring.append(_synthetic_sample(t, 100e6 + t * 50e6, age=9))
+    v._check_slos(v.ring[-1])
+    assert not v.breach_counts()
+    app.graceful_stop()
+
+
+# -- surfaces ----------------------------------------------------------------
+
+def test_vitals_endpoint_roundtrip_and_prometheus_gauges():
+    app = _mk_app()
+    handler = CommandHandler(app)
+    code, body = handler.handle("vitals", {"sample": "true"})
+    assert code == 200
+    rep = body["vitals"]
+    assert rep["enabled"] is True and rep["samples"] >= 1
+    assert rep["latest"]["rss_bytes"] > 0
+    assert set(rep["slopes_per_s"]) >= {"rss_bytes", "open_fds"}
+    json.dumps(body)  # serializable verbatim
+    code, prom = handler.handle("metrics", {"format": "prometheus"})
+    text = prom.data.decode()
+    assert "# TYPE vitals_rss_bytes gauge" in text
+    assert "vitals_open_fds" in text
+    app.graceful_stop()
+
+
+def test_vitals_disabled_is_inert_but_reportable():
+    app = _mk_app(VITALS_ENABLED=False)
+    assert app.vitals._timer is None and not app.vitals._gc_registered
+    handler = CommandHandler(app)
+    code, body = handler.handle("vitals", {})
+    assert code == 200
+    assert body["vitals"]["enabled"] is False
+    assert body["vitals"]["samples"] == 0
+    app.graceful_stop()

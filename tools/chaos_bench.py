@@ -43,7 +43,16 @@ OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
 
 TIERS = {
     # label -> (factory(persist_dir), n_nodes, scenario duration s)
-    "core4": (lambda d: core(4, persist_dir=d, MANUAL_CLOSE=False), 4, 18.0),
+    # core4 runs PIPELINED_CLOSE on (4 tail workers): every chaos
+    # scenario — partitions, mid-close kill-restore, Byzantine twins —
+    # then exercises the overlap contract (write-ahead overlay, depth-1
+    # barrier, seal-to-commit crash window), not just the synchronous
+    # close.  tiered50 stays pipeline-off: 50 tail workers in one
+    # process would add ~50 threads and the tier's wall budget
+    # (~13 s/virtual-second, dominated by quorum evaluation) predates
+    # the pipeline; re-budget before flipping it.
+    "core4": (lambda d: core(4, persist_dir=d, MANUAL_CLOSE=False,
+                             PIPELINED_CLOSE=True), 4, 18.0),
     "tiered50": (lambda d: hierarchical_quorum(
         10, 5, persist_dir=d, MANUAL_CLOSE=False), 50, 12.0),
 }

@@ -43,6 +43,12 @@ SANCTIONED_MODULES = frozenset({
     f"{PACKAGE}/utils/logging.py",
     f"{PACKAGE}/utils/scheduler.py",
     f"{PACKAGE}/utils/device.py",
+    # observation-only telemetry, same standing as tracing.py: the
+    # lifecycle tracker's and vitals sampler's wallclock reads live in
+    # these files and feed only histograms/gauges, never consensus
+    # values (pinned by tests/test_detlint.py)
+    f"{PACKAGE}/utils/txtrace.py",
+    f"{PACKAGE}/utils/vitals.py",
     f"{PACKAGE}/main/config.py",
 })
 

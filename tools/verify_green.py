@@ -33,7 +33,11 @@ Usage: python tools/verify_green.py            -> exit 0 iff green
            core-4 partition+heal run incl. the same-seed determinism
            rerun, via tools/chaos_bench.py); --skip-pipeline-smoke
            skips the PIPELINED_CLOSE=1 tier-1 rerun + the on/off
-           hash/meta parity mini-bench (tools/pipeline_bench.py).
+           hash/meta parity mini-bench (tools/pipeline_bench.py);
+           --skip-soak-smoke skips the ~30 s sustained-load soak
+           (tools/soak_bench.py --smoke: vitals ring populated, memory
+           slope under the SLO ceiling, zero breaches, telemetry
+           disabled-cost <1% and on/off hash parity).
 """
 import json
 import os
@@ -268,6 +272,61 @@ def run_chaos_smoke() -> "tuple":
     return problems, summary
 
 
+def run_soak_smoke() -> "tuple":
+    """A ~30-clock-second sustained-load soak (tools/soak_bench.py
+    --smoke): rate-mode load on a disk-backed REAL_TIME node, then the
+    vitals/SLO verdicts — the ring must be populated, the RSS slope
+    must sit under the watchdog ceiling (zero SLO breaches), the
+    telemetry disabled-cost must stay <1% of close p50, and the
+    telemetry on/off hash+meta parity must hold.  Returns
+    (problems, summary)."""
+    out = "/tmp/_t1_soak_smoke.json"
+    cmd = [sys.executable, os.path.join("tools", "soak_bench.py"),
+           "--smoke", "--out", out]
+    print(f"verify_green: [soak smoke] {' '.join(cmd)}", flush=True)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=600)
+    if proc.returncode != 0:
+        tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-6:])
+        return [f"soak smoke exited {proc.returncode}: {tail}"], "failed"
+    try:
+        with open(out) as f:
+            rep = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"soak smoke report unreadable: {e}"], "failed"
+    problems = []
+    vit = rep.get("vitals", {})
+    if vit.get("samples", 0) < 10:
+        problems.append(
+            f"soak smoke: vitals ring underpopulated "
+            f"({vit.get('samples')} samples over a ~30 s run)")
+    if not rep.get("slo", {}).get("watchdog_green"):
+        problems.append(
+            f"soak smoke: SLO breaches {rep.get('slo', {})}")
+    cost = rep.get("disabled_cost", {})
+    disabled_pct = cost.get("disabled_pct")
+    if disabled_pct is None or disabled_pct >= 1.0:
+        problems.append(
+            f"soak smoke: telemetry disabled-cost {disabled_pct}% of "
+            f"close p50 (gate: <1%)")
+    par = rep.get("parity", {})
+    if not (par.get("hashes_identical") and
+            par.get("meta_bytes_identical")):
+        problems.append("soak smoke: telemetry on/off parity DIVERGED")
+    summary = (f"{rep.get('sustained', {}).get('applied_tx_s')} tx/s "
+               f"applied over "
+               f"{rep.get('sustained', {}).get('ledgers_closed')} "
+               f"ledgers, rss slope "
+               f"{vit.get('rss_slope_mb_s')} MB/s, "
+               f"{vit.get('samples')} vitals samples, disabled-cost "
+               f"{disabled_pct}% (enabled A/B "
+               f"{cost.get('enabled_overhead_pct')}%), parity "
+               f"{'ok' if par.get('hashes_identical') else 'FAILED'}")
+    return problems, summary
+
+
 def main() -> int:
     timings = "--timings" in sys.argv
     if "--lint-only" in sys.argv:
@@ -287,6 +346,7 @@ def main() -> int:
     skip_fallback = "--skip-fallback-smoke" in sys.argv
     skip_chaos = "--skip-chaos-smoke" in sys.argv
     skip_pipeline = "--skip-pipeline-smoke" in sys.argv
+    skip_soak = "--skip-soak-smoke" in sys.argv
     if smoke_only:
         cmd = tier1_command()
         problems, passed, summary = run_parallel_smoke(cmd)
@@ -366,6 +426,11 @@ def main() -> int:
         print(f"verify_green: chaos smoke: {chaos_summary}", flush=True)
         problems.extend(chaos_problems)
         smoke_note += f", chaos smoke: {chaos_summary}"
+    if not skip_soak:
+        soak_problems, soak_summary = run_soak_smoke()
+        print(f"verify_green: soak smoke: {soak_summary}", flush=True)
+        problems.extend(soak_problems)
+        smoke_note += f", soak smoke: {soak_summary}"
     if problems:
         print(f"verify_green: RED ({'; '.join(problems)}); "
               f"passed={passed}", flush=True)
