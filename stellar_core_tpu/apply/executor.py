@@ -176,7 +176,8 @@ class ClusterResult:
 
     __slots__ = ("cluster_id", "records", "delta", "okeys", "vkeys",
                  "header", "op_costs", "span_seconds", "encode_seconds",
-                 "native", "batched")
+                 "native", "batched", "op_kinds", "native_op",
+                 "native_code")
 
     def __init__(self, cluster_id: int):
         self.cluster_id = cluster_id
@@ -196,6 +197,12 @@ class ClusterResult:
         # applied as part of a multi-cluster batched kernel crossing
         # (ROADMAP 2d amortized dispatch)
         self.batched = False
+        # taxonomy: tx count per kernel-shape kind on a hit, and the
+        # (op family, reason slug) of a decline — both feed the
+        # per-op-type apply.native.* metric breakout
+        self.op_kinds: Dict[str, int] = {}
+        self.native_op: Optional[str] = None
+        self.native_code: Optional[str] = None
 
 
 class ParallelApplyManager:
@@ -456,12 +463,25 @@ class ParallelApplyManager:
             if res.native == "hit":
                 self.stats["native_hits"] += 1
                 metrics.counter("apply.native.hit").inc()
+                # per-op-type hit attribution (tx-granular: a cluster
+                # may mix op families)
+                for kind in sorted(res.op_kinds):
+                    metrics.counter(
+                        f"apply.native.hit.{kind}").inc(
+                            res.op_kinds[kind])
                 if res.batched:
                     self.stats["batched_clusters"] += 1
                     metrics.counter("apply.native.batched_clusters").inc()
             elif res.native is not None:
                 self.stats["native_declines"] += 1
                 metrics.counter("apply.native.decline").inc()
+                # reason x op-type breakout: a decline storm names its
+                # exact coverage gap in /metrics instead of hiding
+                # behind one opaque counter
+                metrics.counter(
+                    "apply.native.decline."
+                    f"{res.native_op or 'cluster'}."
+                    f"{res.native_code or 'unknown'}").inc()
                 self.stats["native_decline_reasons"].append(
                     res.native[len("decline:"):])
                 del self.stats["native_decline_reasons"][:-32]
@@ -574,6 +594,7 @@ class ParallelApplyManager:
         from ..utils import tracing
 
         decline_reason = None
+        decline_op = decline_code = None
         native_res = None
         if self.native_wanted and cluster.kernel_ok:
             from .native_apply import KernelDecline, run_cluster_native
@@ -589,6 +610,7 @@ class ParallelApplyManager:
                         ClusterResult)
                 except KernelDecline as e:
                     decline_reason = str(e)
+                    decline_op, decline_code = e.op, e.code
                     if nspan.args is not None:
                         nspan.args["outcome"] = "decline"
                         nspan.args["reason"] = decline_reason
@@ -604,6 +626,8 @@ class ParallelApplyManager:
         res = ClusterResult(cluster.cluster_id)
         if decline_reason is not None:
             res.native = f"decline:{decline_reason}"
+            res.native_op = decline_op
+            res.native_code = decline_code
         view = ClusterView(snapshot, cluster, abort)
         with tracer.span("ledger.apply.cluster", parent=parent_token,
                          cluster=cluster.cluster_id,

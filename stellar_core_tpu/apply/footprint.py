@@ -134,15 +134,10 @@ class PlanContext:
                 self._declare_offer(mat, entry)
             rows.sort()
             mat.offers[direction] = rows
-        # the pair's liquidity pool (path payments quote it on each hop)
-        a, b = ((selling, buying)
-                if LP.compare_assets(selling, buying) < 0
-                else (buying, selling))
-        params = T.LiquidityPoolParameters.make(
-            T.LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT,
-            T.LiquidityPoolConstantProductParameters.make(
-                assetA=a, assetB=b, fee=T.LIQUIDITY_POOL_FEE_V18))
-        mat.keys.add(key_bytes(LP.pool_key(LP.pool_id_from_params(params))))
+        # the pair's liquidity pool (path payments quote it on each hop;
+        # the native kernel probes the same key for its decline-if-live
+        # pool guard — pair_pool_key_bytes is the one derivation)
+        mat.keys.add(LP.pair_pool_key_bytes(selling, buying))
         # issuer accounts: crossing checks their existence
         for asset in (selling, buying):
             issuer = None if U.is_native(asset) else U.asset_issuer(asset)
@@ -278,6 +273,17 @@ def _fp_manage_offer(fp, opf, ctx):
             fp.reads.add(ik)
     if offer_id:
         fp.writes.add(_offer_kb(src, offer_id))
+        # modify/delete releases the LOADED offer's liabilities, whose
+        # assets may differ from the op's declared pair: without the
+        # resting offer's own trustline reach the release is an
+        # undeclared write (worker escape / kernel decline) every time
+        existing = ctx.ltx.get(_offer_kb(src, offer_id))
+        if existing is not None:
+            o = existing.data.value
+            for asset in (o.selling, o.buying):
+                kb = _tl_kb(src, asset)
+                if kb is not None:
+                    fp.writes.add(kb)
     if amount != 0:
         # the pair's materialized reach (resting offers, sellers,
         # trustlines, pool, sponsors) is attached ONCE per pair by the

@@ -10,6 +10,14 @@ re-wraps the kernel's outputs — packed entry deltas, pre-encoded
 TransactionMeta / TransactionResult bytes — into the ``ClusterResult``
 shape the merge/hash/commit phases already consume.
 
+The kernel-shaped strip (ISSUE 13 kernel-complete apply): native AND
+credit payments, CHANGE_TRUST create/update/delete over classic
+assets, MANAGE_SELL_OFFER create/modify/delete (offerID 0 and !=0),
+and PATH_PAYMENT strict-send/strict-receive over declared hop pairs
+(per-hop pool descriptors ride the shape so the kernel can decline a
+hop whose pair has a LIVE liquidity pool — pool quoting stays
+host-side).
+
 Parity contract: the kernel implements success paths only.  Any
 structural mismatch, unexpected entry state, failing check or
 arithmetic divergence comes back as a ``KernelDecline`` and the caller
@@ -34,8 +42,30 @@ from ..xdr import types as T
 OT = T.OperationType
 
 
+def _reason_slug(msg: str) -> str:
+    """Stable metric-label slug of a decline reason (the kernel's
+    ``need()`` strings are the taxonomy; host-side raises ride along)."""
+    import re
+
+    return re.sub(r"[^a-z0-9]+", "_", msg.lower()).strip("_")[:48] or \
+        "unknown"
+
+
 class KernelDecline(Exception):
-    """The kernel cannot apply this cluster; Python apply takes it."""
+    """The kernel cannot apply this cluster; Python apply takes it.
+
+    Carries the decline taxonomy: ``op`` is the kernel-shape kind of
+    the offending tx (``payment`` / ``offer`` / ``trust`` / ``pathpay``,
+    or ``cluster`` for whole-cluster refusals) and ``code`` the reason
+    slug — together they feed the ``apply.native.decline.<op>.<code>``
+    metric breakout, so a decline storm names the exact coverage gap
+    instead of bumping one opaque counter."""
+
+    def __init__(self, msg: str, op: str = "cluster",
+                 code: Optional[str] = None):
+        super().__init__(msg)
+        self.op = op
+        self.code = code if code is not None else _reason_slug(msg)
 
 
 def _screen_account(snapshot, account_id: bytes, idx: int) -> None:
@@ -54,20 +84,31 @@ def _screen_account(snapshot, account_id: bytes, idx: int) -> None:
         acc = e.data.value
         if acc.signers or acc.inflationDest is not None:
             raise KernelDecline(
-                f"tx {idx}: unsupported account shape (host screen)")
+                f"tx {idx}: unsupported account shape (host screen)",
+                code="unsupported_account_shape")
 
 
 #: protocol constants the C kernel hardcodes (apply_kernel.cpp) paired
 #: with their Python source of truth — asserted before every dispatch
 #: so a constant drift disables the kernel instead of risking a fork
+#: (the full manifest lives in tools/lint/lockstep.json; detlint's
+#: native-lockstep gate diffs both sides statically)
 def _constants_in_lockstep() -> bool:
     from ..transactions import utils as U
 
     return (U.MAX_OFFERS_TO_CROSS == 1000
             and U.ACCOUNT_SUBENTRY_LIMIT == 1000
+            and U.MAX_PATH_HOPS == 6
             and U.INT64_MAX == 2**63 - 1
             and int(T.AUTHORIZED_FLAG) == 1
-            and int(T.PASSIVE_FLAG) == 1)
+            and int(T.AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG) == 2
+            and int(T.TRUSTLINE_CLAWBACK_ENABLED_FLAG) == 4
+            and int(T.AUTH_REQUIRED_FLAG) == 1
+            and int(T.AUTH_CLAWBACK_ENABLED_FLAG) == 8
+            and int(T.PASSIVE_FLAG) == 1
+            and int(OT.CHANGE_TRUST) == 6
+            and int(OT.PATH_PAYMENT_STRICT_RECEIVE) == 2
+            and int(OT.PATH_PAYMENT_STRICT_SEND) == 13)
 
 
 def kernel_module():
@@ -84,6 +125,9 @@ def frame_kernel_shape(frame) -> Optional[tuple]:
 
     Pure function of the transaction — safe to compute at plan time
     (including nomination-time preplans) and cache on the footprint.
+    Structurally-MALFORMED bodies return None on purpose: malformed is
+    a FAILURE result, and the Python reference owns every non-success
+    outcome.
     """
     from ..transactions import utils as U
     from ..transactions.frame import TransactionFrame
@@ -103,16 +147,74 @@ def frame_kernel_shape(frame) -> Optional[tuple]:
     body = op.body
     if body.type == OT.PAYMENT:
         b = body.value
-        if b.asset.type != T.AssetType.ASSET_TYPE_NATIVE:
-            return None  # credit payments keep the trustline reference path
-        return ("payment", U.muxed_to_account_id(b.destination), b.amount)
+        if not U.is_asset_valid(b.asset) or b.amount <= 0:
+            return None
+        return ("payment", U.muxed_to_account_id(b.destination), b.amount,
+                T.Asset.encode(b.asset))
     if body.type == OT.MANAGE_SELL_OFFER:
         b = body.value
-        if b.offerID != 0 or b.amount <= 0:
-            return None  # modify/delete keep the reference path
+        if b.amount < 0 or b.offerID < 0 or \
+                (b.amount == 0 and b.offerID == 0):
+            return None  # malformed keeps the reference path
+        if b.price.n <= 0 or b.price.d <= 0:
+            return None
         return ("offer", T.Asset.encode(b.selling),
-                T.Asset.encode(b.buying), b.amount, b.price.n, b.price.d)
+                T.Asset.encode(b.buying), b.amount, b.price.n, b.price.d,
+                b.offerID)
+    if body.type == OT.CHANGE_TRUST:
+        b = body.value
+        line = b.line
+        if line.type in (T.AssetType.ASSET_TYPE_NATIVE,
+                         T.AssetType.ASSET_TYPE_POOL_SHARE):
+            return None  # native is malformed; pool shares stay host-side
+        asset = T.Asset.make(line.type, line.value)
+        if not U.is_asset_valid(asset) or b.limit < 0:
+            return None
+        if U.asset_issuer(asset) == frame.source_account_id():
+            return None  # SELF_NOT_ALLOWED is a failure result
+        return ("trust", T.Asset.encode(asset), b.limit)
+    if body.type in (OT.PATH_PAYMENT_STRICT_SEND,
+                     OT.PATH_PAYMENT_STRICT_RECEIVE):
+        b = body.value
+        strict_send = body.type == OT.PATH_PAYMENT_STRICT_SEND
+        chain = [b.sendAsset, *b.path, b.destAsset]
+        if len(chain) - 1 > U.MAX_PATH_HOPS:
+            return None
+        for a in chain:
+            if not U.is_asset_valid(a):
+                return None
+        if strict_send:
+            if b.sendAmount <= 0 or b.destMin <= 0:
+                return None
+            amount, amount2 = b.sendAmount, b.destMin
+        else:
+            if b.destAmount <= 0 or b.sendMax <= 0:
+                return None
+            amount, amount2 = b.sendMax, b.destAmount
+        hops = _path_hops(chain)
+        return ("pathpay", U.muxed_to_account_id(b.destination),
+                int(body.type), T.Asset.encode(b.sendAsset), amount,
+                T.Asset.encode(b.destAsset), amount2, hops)
     return None
+
+
+def _path_hops(chain) -> tuple:
+    """The effective conversion steps of a path-payment chain: adjacent
+    equal assets collapse (exactly the reference's ``assets_equal``
+    skip), and each hop carries its pair's liquidity-pool key so the
+    kernel can run its decline-if-live pool probe against a DECLARED
+    key."""
+    from ..transactions import liquidity_pool as LP
+    from ..transactions import utils as U
+
+    hops = []
+    for i in range(len(chain) - 1):
+        if U.assets_equal(chain[i], chain[i + 1]):
+            continue
+        hops.append((T.Asset.encode(chain[i]),
+                     T.Asset.encode(chain[i + 1]),
+                     LP.pair_pool_key_bytes(chain[i], chain[i + 1])))
+    return tuple(hops)
 
 
 def _signature_ok(frame, verify) -> bool:
@@ -127,14 +229,22 @@ def _signature_ok(frame, verify) -> bool:
 
 
 def _tx_tuple(frame, shape) -> tuple:
-    if shape[0] == "payment":
-        return (int(OT.PAYMENT), frame.full_hash(),
-                frame.source_account_id(), frame.seq_num(), frame.tx.fee,
-                frame.fee_charged, shape[1], shape[2])
-    return (int(OT.MANAGE_SELL_OFFER), frame.full_hash(),
-            frame.source_account_id(), frame.seq_num(), frame.tx.fee,
-            frame.fee_charged, shape[1], shape[2], shape[3], shape[4],
-            shape[5])
+    head = (frame.full_hash(), frame.source_account_id(),
+            frame.seq_num(), frame.tx.fee, frame.fee_charged)
+    kind = shape[0]
+    if kind == "payment":
+        # (dest, amount, asset)
+        return (int(OT.PAYMENT), *head, shape[1], shape[2], shape[3])
+    if kind == "offer":
+        # (selling, buying, amount, price_n, price_d, offer_id)
+        return (int(OT.MANAGE_SELL_OFFER), *head, shape[1], shape[2],
+                shape[3], shape[4], shape[5], shape[6])
+    if kind == "trust":
+        # (line asset, limit)
+        return (int(OT.CHANGE_TRUST), *head, shape[1], shape[2])
+    # pathpay: (dest, op, send_asset, amount, dest_asset, amount2, hops)
+    return (shape[2], *head, shape[1], shape[3], shape[4], shape[5],
+            shape[6], shape[7])
 
 
 def _kernel_ready(snapshot):
@@ -161,15 +271,47 @@ def _screen_cluster(cluster, snapshot, apply_order, verify):
     for idx, frame, shape in zip(cluster.indices, frames,
                                  cluster.shapes):
         if shape is None:
-            raise KernelDecline(f"tx {idx} not kernel-shaped")
+            raise KernelDecline(f"tx {idx} not kernel-shaped",
+                                code="not_kernel_shaped")
         if not _signature_ok(frame, verify):
             # a failing signature is a FAILURE result, not a success —
             # the reference path owns every non-success outcome
-            raise KernelDecline(f"tx {idx} signature not clean")
+            raise KernelDecline(f"tx {idx} signature not clean",
+                                op=shape[0], code="signature_not_clean")
         _screen_account(snapshot, frame.source_account_id(), idx)
-        if shape[0] == "payment":
+        if shape[0] in ("payment", "pathpay"):
+            # destination accounts are touched by every payment-shaped
+            # apply; screen their persistent unsupported shapes too
             _screen_account(snapshot, shape[1], idx)
     return frames
+
+
+def _shape_kinds(clusters) -> "List[str]":
+    """Kernel-shape kind of every tx across ``clusters`` in dispatch
+    order — the map from a kernel decline's tx_index back to the op
+    family for the decline-taxonomy metrics."""
+    kinds: List[str] = []
+    for cluster in clusters:
+        kinds.extend(s[0] if s is not None else "cluster"
+                     for s in cluster.shapes)
+    return kinds
+
+
+def _kernel_declined(kinds, reason, tx_index, batched=False):
+    what = "batched tx" if batched else "tx"
+    op = kinds[tx_index] if 0 <= tx_index < len(kinds) else "cluster"
+    return KernelDecline(f"kernel declined {what} {tx_index}: {reason}",
+                         op=op, code=_reason_slug(reason))
+
+
+def _kind_counts(cluster) -> dict:
+    """tx count per kernel-shape kind — feeds the per-op-type
+    ``apply.native.hit.<op>`` attribution on a kernel hit."""
+    counts: dict = {}
+    for s in cluster.shapes:
+        kind = s[0] if s is not None else "cluster"
+        counts[kind] = counts.get(kind, 0) + 1
+    return counts
 
 
 def _pack_inputs(snapshot, keys, pairs):
@@ -237,20 +379,24 @@ def run_cluster_native(cluster, snapshot, apply_order, verify,
     out = mod.apply_cluster(params, entries, books, txs)
     if not out[0]:
         _, reason, tx_index = out
-        raise KernelDecline(f"kernel declined tx {tx_index}: {reason}")
+        raise _kernel_declined(_shape_kinds([cluster]), reason, tx_index)
     _, deltas, records, idpool_final = out
 
     from .executor import _is_fresh_offer_key
 
     res = result_cls(cluster.cluster_id)
     res.native = "hit"
+    res.op_kinds = _kind_counts(cluster)
     declared = cluster.writes
     for kb, eb in deltas:
         # write-side guard, mirroring the executor's _post_check: every
         # kernel write must be a declared write or a fresh offer id
         if kb not in declared and not _is_fresh_offer_key(
                 kb, snapshot.idpool0):
-            raise KernelDecline(f"kernel wrote undeclared key {kb.hex()}")
+            # fixed code: the key hex must not leak into the metric
+            # name (unbounded counter cardinality in a decline storm)
+            raise KernelDecline(f"kernel wrote undeclared key {kb.hex()}",
+                                code="undeclared_write")
         res.delta[kb] = None if eb is None else PackedEntry(eb)
         if kb.startswith(_OFFER_PREFIX):
             res.okeys.add(kb)
@@ -307,8 +453,8 @@ def run_clusters_native_batched(clusters, snapshot, apply_order, verify,
     out = mod.apply_cluster(params, entries, books, txs)
     if not out[0]:
         _, reason, tx_index = out
-        raise KernelDecline(
-            f"kernel declined batched tx {tx_index}: {reason}")
+        raise _kernel_declined(_shape_kinds(clusters), reason, tx_index,
+                               batched=True)
     _, deltas, records, idpool_final = out
     if idpool_final != snapshot.idpool0:
         raise KernelDecline("batched kernel allocated offer ids")
@@ -317,6 +463,7 @@ def run_clusters_native_batched(clusters, snapshot, apply_order, verify,
     for c in clusters:
         res = result_cls(c.cluster_id)
         res.native = "hit"
+        res.op_kinds = _kind_counts(c)
         results[c.cluster_id] = res
     for kb, eb in deltas:
         cluster = owner.get(kb)
@@ -324,7 +471,8 @@ def run_clusters_native_batched(clusters, snapshot, apply_order, verify,
         # so every write must belong to exactly one declared key set
         if cluster is None or kb not in cluster.writes:
             raise KernelDecline(
-                f"batched kernel wrote undeclared key {kb.hex()}")
+                f"batched kernel wrote undeclared key {kb.hex()}",
+                code="undeclared_write")
         res = results[cluster.cluster_id]
         res.delta[kb] = None if eb is None else PackedEntry(eb)
         if kb.startswith(_OFFER_PREFIX):

@@ -25,6 +25,14 @@ object model instead:
 Both resolve to ordinary runtime values on first touch, so equality,
 repr and isinstance checks all behave; the laziness is an encoding
 fast path, never an observable state.
+
+Since the kernel went credit-complete (ISSUE 13), its deltas carry
+trustline entries in every liability shape the kernel models — ext v0,
+ext v1 (liabilities) and ext v1+v2 (liquidityPoolUseCount) — plus
+created/erased trustlines; all of them ride this tier unchanged
+because the wrappers are shape-agnostic: the packed bytes ARE the
+value, and the decode (when an invariant or the SQL index touches one)
+goes through the ordinary ``T.LedgerEntry`` combinator.
 """
 from __future__ import annotations
 

@@ -82,6 +82,11 @@ class CommandHandler:
             pstats["prefetch_adopted"] / pstats["prefetch_keys"]
             if pstats["prefetch_keys"] else 0.0)
         m.counter("apply.native.batched_clusters")
+        # aggregate hit/decline counters pinned present from boot; the
+        # per-op-type breakout (apply.native.hit.<op> and
+        # apply.native.decline.<op>.<reason>) registers on first event
+        m.counter("apply.native.hit")
+        m.counter("apply.native.decline")
         # ?format=prometheus: text exposition of the registry (plus the
         # flight recorder's span-derived timers, which live in the
         # registry as span.* Timers).  The default JSON body below is
@@ -199,11 +204,15 @@ class CommandHandler:
         return 200, {"ledger": seq}
 
     def generateload(self, params):
-        """generateload?mode=create|pay|pretend|mixed&accounts=N&txs=N
-        [&dexpct=N&opcount=N] — drives the LoadGenerator through the
-        real tx queue (ref CommandHandler.cpp:125; the reference
-        registers this only in test builds, here it requires the
-        standalone/testing accelerators to be on)."""
+        """generateload?mode=create|pay|pretend|mixed|credit|pathpay
+        &accounts=N&txs=N [&dexpct=N&opcount=N&trustpct=N&hops=N] —
+        drives the LoadGenerator through the real tx queue (ref
+        CommandHandler.cpp:125; the reference registers this only in
+        test builds, here it requires the standalone/testing
+        accelerators to be on).  ``credit`` and ``pathpay`` seed
+        themselves over real transactions in stages — call the mode
+        repeatedly with a manualclose between calls until the note
+        stops asking for another stage."""
         cfg = self.app.config
         if not (cfg.RUN_STANDALONE
                 or cfg.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING):
@@ -294,6 +303,42 @@ class CommandHandler:
                               lambda: setattr(lg, "_dex_stage", 3))
             envs = lg.generate_mixed(
                 n_txs, dex_percent=int(params.get("dexpct", "50")))
+        elif mode == "credit":
+            # credit-heavy mix (ISSUE 13): LOAD payments over
+            # trustlines + changeTrust salt on CRD2.  Staged like
+            # mode=mixed: issuers -> trustlines -> funding, one close
+            # between calls
+            stage = getattr(lg, "_credit_stage", 0)
+            if stage == 0:
+                return submit(lg.create_credit_issuer_envelopes(),
+                              "credit issuers submitted; close a "
+                              "ledger and call mode=credit again",
+                              lambda: setattr(lg, "_credit_stage", 1))
+            if stage == 1:
+                return submit(lg.setup_dex_envelopes(),
+                              "trustlines submitted; close a ledger "
+                              "and call mode=credit again",
+                              lambda: setattr(lg, "_credit_stage", 2))
+            if stage == 2:
+                return submit(lg.fund_dex_envelopes(),
+                              "funding submitted; close a ledger and "
+                              "call mode=credit again",
+                              lambda: setattr(lg, "_credit_stage", 3))
+            envs = lg.generate_credit_mix(
+                n_txs, trust_pct=int(params.get("trustpct", "10")))
+        elif mode == "pathpay":
+            # multi-hop path payments over seeded books (ISSUE 13):
+            # four tx-based seeding stages (issuers+makers, trustlines,
+            # funding, maker offers), then the workload
+            hops = int(params.get("hops", "2"))
+            stage = getattr(lg, "_path_stage", 0)
+            if stage < 4:
+                return submit(
+                    lg.path_stage_envelopes(stage, hops=hops),
+                    f"path seeding stage {stage} submitted; close a "
+                    f"ledger and call mode=pathpay again",
+                    lambda: setattr(lg, "_path_stage", stage + 1))
+            envs = lg.generate_path_payments(n_txs)
         else:
             return 400, {"error": f"unknown mode {mode!r}"}
         return submit(envs)

@@ -11,13 +11,24 @@
  * bytes that the merge/hash/commit phases consume exactly as they
  * consume the Python workers' output today.
  *
- * Covered op strip (the two types dominating BENCH_TRACE_r08's per-op
- * attribution):
- *   - PAYMENT, native asset, between plain accounts;
- *   - MANAGE_SELL_OFFER, offerID=0 (create), native/alphanum assets,
- *     full exchangeV10 crossing loop mirroring
+ * Covered op strip (kernel-complete for the op types dominating real
+ * Stellar traffic — ISSUE 13 closes the credit/path/modify gap PR 6
+ * left open):
+ *   - PAYMENT, native AND credit assets (trustline balance edges,
+ *     AUTHORIZED gate, issuer-source / issuer-dest mint-burn cases);
+ *   - CHANGE_TRUST, classic assets: trustline create (issuer flag
+ *     derivation, subentry reserve), limit update, and delete;
+ *   - MANAGE_SELL_OFFER, offerID=0 (create) AND offerID!=0
+ *     (modify/delete): load the resting offer from the packed
+ *     snapshot, release old liabilities, re-run the crossing loop,
+ *     re-post or delete; full exchangeV10 crossing mirroring
  *     transactions/offer_exchange.py (adjustOffer, liabilities
- *     acquire/release, price-error thresholds, claim atoms).
+ *     acquire/release, price-error thresholds, claim atoms);
+ *   - PATH_PAYMENT_STRICT_SEND / _RECEIVE over declared hop pairs:
+ *     the multi-hop chain walk with per-hop send/receive propagation,
+ *     the strict-send/strict-receive rounding modes, max-path-length
+ *     and self-crossing guards.  Hops whose pair has a LIVE liquidity
+ *     pool decline (pool quoting stays host-side).
  *
  * Parity discipline: the kernel implements ONLY the success paths.
  * Any ineligible shape, unexpected entry state, failing check, or
@@ -51,17 +62,38 @@ typedef __int128 i128;
 static const int64_t INT64_MAX_ = 9223372036854775807LL;
 static const uint32_t ACCOUNT_SUBENTRY_LIMIT = 1000;
 static const int MAX_OFFERS_TO_CROSS = 1000;
+/* longest effective conversion chain: 5 path entries + send + dest
+ * assets = 6 hops (xdr/types.py VarArray(Asset, 5) path bound) */
+static const int MAX_PATH_HOPS = 6;
 
 /* OperationType values (xdr/types.py) */
-enum { OP_PAYMENT = 1, OP_MANAGE_SELL_OFFER = 3 };
+enum {
+    OP_PAYMENT = 1,
+    OP_PATH_PAYMENT_STRICT_RECEIVE = 2,
+    OP_MANAGE_SELL_OFFER = 3,
+    OP_CHANGE_TRUST = 6,
+    OP_PATH_PAYMENT_STRICT_SEND = 13,
+};
 /* LedgerEntryType */
 enum { LE_ACCOUNT = 0, LE_TRUSTLINE = 1, LE_OFFER = 2 };
 /* LedgerEntryChangeType */
 enum { CH_CREATED = 0, CH_UPDATED = 1, CH_REMOVED = 2, CH_STATE = 3 };
 /* trustline flags */
 static const uint32_t AUTHORIZED_FLAG = 1;
+static const uint32_t MAINTAIN_LIABILITIES_FLAG = 2;
+static const uint32_t TL_CLAWBACK_FLAG = 4;
+/* account flags consulted by changeTrust's new-trustline derivation */
+static const uint32_t ACC_AUTH_REQUIRED_FLAG = 1;
+static const uint32_t ACC_AUTH_CLAWBACK_FLAG = 8;
 /* offer flags */
 static const uint32_t PASSIVE_FLAG = 1;
+/* TrustLineEntry extension discriminants (liability XDR tags) */
+enum { TL_EXT_V1 = 1, TL_V1_EXT_V2 = 2 };
+/* ManageOfferEffect */
+enum { EFF_CREATED = 0, EFF_UPDATED = 1, EFF_DELETED = 2 };
+/* offer_exchange.RoundingType */
+enum { ROUND_NORMAL = 0, ROUND_PP_STRICT_RECEIVE = 1,
+       ROUND_PP_STRICT_SEND = 2 };
 
 struct Decline {
     std::string reason;
@@ -194,8 +226,9 @@ struct TlState {
     std::string asset;   /* TrustLineAsset == Asset bytes */
     int64_t balance = 0, limit = 0;
     uint32_t flags = 0;
-    bool has_v1 = false;
+    bool has_v1 = false, has_v2 = false;
     int64_t liab_buying = 0, liab_selling = 0;
+    int32_t pool_use_count = 0; /* ext v2 liquidityPoolUseCount */
 };
 
 struct OfferState {
@@ -227,16 +260,33 @@ struct BookDir {
     std::vector<std::string> rows; /* offer key bytes */
 };
 
+struct Hop {
+    /* one effective conversion step (equal-adjacent assets already
+     * collapsed host-side): sell ``selling`` for ``buying``; pool_key
+     * is the hop pair's liquidity-pool LedgerKey — declared by the
+     * footprint, probed for the decline-if-live guard */
+    std::string selling, buying, pool_key;
+};
+
 struct Tx {
     int op = 0;
     std::string hash, src; /* raw 32 */
     int64_t seq = 0, fee = 0, fee_charged = 0;
-    /* payment */
+    /* payment / path payments */
     std::string dest;
     int64_t amount = 0;
+    std::string asset; /* payment asset / changeTrust line */
     /* offer */
     std::string selling, buying;
     int32_t price_n = 0, price_d = 0;
+    int64_t offer_id = 0;
+    /* change_trust */
+    int64_t limit = 0;
+    /* path payments: amount carries sendAmount (strict send) or
+     * sendMax (strict receive); amount2 carries destMin / destAmount */
+    int64_t amount2 = 0;
+    std::string dest_asset;
+    std::vector<Hop> hops;
 };
 
 struct Ctx {
@@ -338,10 +388,16 @@ static void encode_trustline(const Entry &e, Wr &w) {
     if (!t.has_v1) {
         w.u32(0);
     } else {
-        w.u32(1);
+        w.u32(TL_EXT_V1);
         w.i64(t.liab_buying);
         w.i64(t.liab_selling);
-        w.u32(0); /* TrustLineEntryV1 ext v0 */
+        if (!t.has_v2) {
+            w.u32(0); /* TrustLineEntryV1 ext v0 */
+        } else {
+            w.u32(TL_V1_EXT_V2);
+            w.i32(t.pool_use_count);
+            w.u32(0); /* TrustLineEntryExtensionV2 ext v0 */
+        }
     }
     w.u32(0); /* LedgerEntry ext v0 */
 }
@@ -464,12 +520,25 @@ static void parse_entry(Entry &e) {
             tl.balance = r.i64();
             tl.limit = r.i64();
             tl.flags = r.u32();
+            /* AUTHORIZED and MAINTAIN_LIABILITIES are mutually
+             * exclusive states; an entry carrying both is corrupt and
+             * must never apply natively */
+            need(!((tl.flags & AUTHORIZED_FLAG) &&
+                   (tl.flags & MAINTAIN_LIABILITIES_FLAG)),
+                 "invalid trustline auth state");
             uint32_t ext = r.u32();
-            if (ext == 1) {
+            if (ext == TL_EXT_V1) {
                 tl.has_v1 = true;
                 tl.liab_buying = r.i64();
                 tl.liab_selling = r.i64();
-                need(r.u32() == 0, "trustline ext v2");
+                uint32_t e1 = r.u32();
+                if (e1 == TL_V1_EXT_V2) {
+                    tl.has_v2 = true;
+                    tl.pool_use_count = r.i32();
+                    need(r.u32() == 0, "trustline ext v2 arm");
+                } else {
+                    need(e1 == 0, "trustline v1 ext arm");
+                }
             } else {
                 need(ext == 0, "trustline ext arm");
             }
@@ -645,15 +714,20 @@ static i128 offer_value(int64_t pn, int64_t pd, int64_t max_send,
 }
 
 static ExchRes exchange_v10_wt(int32_t pn, int32_t pd, int64_t mws,
-                               int64_t mwr, int64_t mss, int64_t msr) {
-    /* exchangeV10WithoutPriceErrorThresholds, RoundingType.NORMAL */
+                               int64_t mwr, int64_t mss, int64_t msr,
+                               int round_) {
+    /* exchangeV10WithoutPriceErrorThresholds — all three rounding
+     * modes (offer_exchange.py:78) */
     i128 wheat_value = offer_value(pn, pd, mws, msr);
     i128 sheep_value = offer_value(pd, pn, mss, mwr);
     ExchRes res;
     res.wheat_stays = wheat_value > sheep_value;
     int64_t wheat_receive, sheep_send;
     if (res.wheat_stays) {
-        if (pn > pd) {
+        if (round_ == ROUND_PP_STRICT_SEND) {
+            wheat_receive = div128(sheep_value, pn, false);
+            sheep_send = mss < msr ? mss : msr;
+        } else if (pn > pd || round_ == ROUND_PP_STRICT_RECEIVE) {
             wheat_receive = div128(sheep_value, pn, false);
             sheep_send = big_divide(wheat_receive, pn, pd, true);
         } else {
@@ -679,50 +753,66 @@ static ExchRes exchange_v10_wt(int32_t pn, int32_t pd, int64_t mws,
     return res;
 }
 
-static bool price_error_ok(int32_t pn, int32_t pd, int64_t wr, int64_t ss) {
-    /* checkPriceErrorBound, can_favor_wheat=False */
+static bool price_error_ok(int32_t pn, int32_t pd, int64_t wr, int64_t ss,
+                           bool can_favor_wheat) {
+    /* checkPriceErrorBound */
     i128 lhs = (i128)100 * pn * wr;
     i128 rhs = (i128)100 * pd * ss;
+    if (can_favor_wheat && rhs > lhs)
+        return true;
     i128 diff = lhs > rhs ? lhs - rhs : rhs - lhs;
     i128 cap = (i128)pn * wr;
     return diff <= cap;
 }
 
 static ExchRes exchange_v10(int32_t pn, int32_t pd, int64_t mws, int64_t mwr,
-                            int64_t mss, int64_t msr) {
-    ExchRes r = exchange_v10_wt(pn, pd, mws, mwr, mss, msr);
-    /* applyPriceErrorThresholds, RoundingType.NORMAL */
+                            int64_t mss, int64_t msr,
+                            int round_ = ROUND_NORMAL) {
+    ExchRes r = exchange_v10_wt(pn, pd, mws, mwr, mss, msr, round_);
+    /* applyPriceErrorThresholds */
     if (r.wheat_receive > 0 && r.sheep_send > 0) {
         i128 wrv = (i128)r.wheat_receive * pn;
         i128 ssv = (i128)r.sheep_send * pd;
         need(!(r.wheat_stays && ssv < wrv), "favored sheep when wheat stays");
         need(!(!r.wheat_stays && ssv > wrv), "favored wheat when sheep stays");
-        if (!price_error_ok(pn, pd, r.wheat_receive, r.sheep_send)) {
+        if (round_ == ROUND_NORMAL) {
+            if (!price_error_ok(pn, pd, r.wheat_receive, r.sheep_send,
+                                false)) {
+                r.wheat_receive = 0;
+                r.sheep_send = 0;
+            }
+        } else {
+            need(price_error_ok(pn, pd, r.wheat_receive, r.sheep_send,
+                                true),
+                 "exceeded price error bound");
+        }
+    } else {
+        if (round_ == ROUND_PP_STRICT_SEND) {
+            need(r.sheep_send != 0, "invalid amount of sheep sent");
+        } else {
             r.wheat_receive = 0;
             r.sheep_send = 0;
         }
-    } else {
-        r.wheat_receive = 0;
-        r.sheep_send = 0;
     }
     return r;
 }
 
 static int64_t adjust_offer_amount(int32_t pn, int32_t pd, int64_t mws,
                                    int64_t msr) {
-    ExchRes r = exchange_v10(pn, pd, mws, INT64_MAX_, INT64_MAX_, msr);
+    ExchRes r = exchange_v10(pn, pd, mws, INT64_MAX_, INT64_MAX_, msr,
+                             ROUND_NORMAL);
     return r.wheat_receive;
 }
 
 static int64_t offer_selling_liab(int32_t pn, int32_t pd, int64_t amount) {
     return exchange_v10_wt(pn, pd, amount, INT64_MAX_, INT64_MAX_,
-                           INT64_MAX_)
+                           INT64_MAX_, ROUND_NORMAL)
         .wheat_receive;
 }
 
 static int64_t offer_buying_liab(int32_t pn, int32_t pd, int64_t amount) {
     return exchange_v10_wt(pn, pd, amount, INT64_MAX_, INT64_MAX_,
-                           INT64_MAX_)
+                           INT64_MAX_, ROUND_NORMAL)
         .sheep_send;
 }
 
@@ -974,19 +1064,55 @@ static void common_checks(Ctx &c, const Tx &tx, Entry &src) {
 
 static void apply_payment(Ctx &c, const Tx &tx) {
     need(tx.amount > 0, "payment amount non-positive");
-    /* credit destination first (ref updateDestBalance order) */
-    Entry *de = load_acct_opt(c, tx.dest);
-    need(de != nullptr, "payment destination missing");
-    need(max_receive(de->acct) >= tx.amount, "payment line full");
-    mark_put(c, *de, account_key(tx.dest));
-    de->acct.balance += tx.amount;
-    /* debit source, re-reading (self-payment nets to zero) */
-    Entry &se = load_acct(c, tx.src, "payment source missing");
-    need(tx.amount <= available_balance(c, se.acct), "payment underfunded");
-    int64_t nb = se.acct.balance - tx.amount;
-    need(nb >= 0 && nb <= INT64_MAX_, "payment balance overflow");
-    mark_put(c, se, account_key(tx.src));
-    se.acct.balance = nb;
+    need(asset_valid(tx.asset), "payment asset invalid");
+    if (asset_is_native(tx.asset)) {
+        /* credit destination first (ref updateDestBalance order) */
+        Entry *de = load_acct_opt(c, tx.dest);
+        need(de != nullptr, "payment destination missing");
+        need(max_receive(de->acct) >= tx.amount, "payment line full");
+        mark_put(c, *de, account_key(tx.dest));
+        de->acct.balance += tx.amount;
+        /* debit source, re-reading (self-payment nets to zero) */
+        Entry &se = load_acct(c, tx.src, "payment source missing");
+        need(tx.amount <= available_balance(c, se.acct),
+             "payment underfunded");
+        int64_t nb = se.acct.balance - tx.amount;
+        need(nb >= 0 && nb <= INT64_MAX_, "payment balance overflow");
+        mark_put(c, se, account_key(tx.src));
+        se.acct.balance = nb;
+        return;
+    }
+    /* credit asset (ref PaymentOpFrame::doApply via the strict-receive
+     * core with an empty path): issuer sides mint/burn freely, the
+     * dest-existence check is bypassed when paying the issuer itself */
+    std::string issuer = asset_issuer(tx.asset);
+    bool bypass_issuer_check = tx.dest == issuer;
+    if (!bypass_issuer_check)
+        need(load_acct_opt(c, tx.dest) != nullptr,
+             "payment destination missing");
+    /* -- 1) credit the destination ------------------------------------ */
+    if (tx.dest != issuer) {
+        Entry *dt = load_tl_opt(c, tx.dest, tx.asset);
+        need(dt != nullptr, "payment no trust");
+        need(tl_authorized(dt->tl), "payment not authorized");
+        TlState &dtl = dt->tl;
+        /* trustline_max_receive: limit - balance - buying */
+        need(dtl.limit - dtl.balance - dtl.liab_buying >= tx.amount,
+             "payment line full");
+        mark_put(c, *dt, trustline_key(tx.dest, tx.asset));
+        dtl.balance += tx.amount;
+    }
+    /* -- 2) debit the source (re-read: may be the same trustline) ----- */
+    if (tx.src != issuer) {
+        Entry *st = load_tl_opt(c, tx.src, tx.asset);
+        need(st != nullptr, "payment src no trust");
+        need(tl_authorized(st->tl), "payment src not authorized");
+        TlState &stl = st->tl;
+        int64_t avail = stl.balance - stl.liab_selling;
+        need((avail > 0 ? avail : 0) >= tx.amount, "payment underfunded");
+        mark_put(c, *st, trustline_key(tx.src, tx.asset));
+        stl.balance -= tx.amount;
+    }
 }
 
 /* opINNER(PAYMENT, PAYMENT_SUCCESS) */
@@ -1018,12 +1144,134 @@ static bool crosses(int32_t book_n, int32_t book_d, int32_t own_n,
     return false;
 }
 
+static void emit_claim_atoms(Wr &w, const std::vector<Atom> &atoms) {
+    w.u32((uint32_t)atoms.size());
+    for (const Atom &at : atoms) {
+        w.u32(1); /* CLAIM_ATOM_TYPE_ORDER_BOOK */
+        w.u32(0); /* sellerID pk disc */
+        w.raw(at.seller);
+        w.i64(at.offer_id);
+        w.raw(at.asset_sold);
+        w.i64(at.amount_sold);
+        w.raw(at.asset_bought);
+        w.i64(at.amount_bought);
+    }
+}
+
+struct ConvertOut {
+    int64_t sheep_sent = 0, wheat_received = 0;
+    std::vector<Atom> atoms;
+};
+
+/* convert_with_offers (offer_exchange.py:340): cross book offers
+ * selling ``wheat`` for ``sheep`` until limits are exhausted.  Book
+ * sellers settle here; the taker's side is the caller's.  The
+ * manage-offer own-price filter engages when filter_pn > 0 (its stop
+ * is a normal outcome); CROSSED_SELF / TOO_MANY_OFFERS / exchange
+ * errors decline — every one is a failure result host-side, and the
+ * kernel owns success paths only. */
+static ConvertOut convert_with_offers(Ctx &c, const std::string &src,
+                                      const std::string &sheep,
+                                      int64_t max_sheep_send,
+                                      const std::string &wheat,
+                                      int64_t max_wheat_receive,
+                                      int round_, int32_t filter_pn,
+                                      int32_t filter_pd) {
+    ConvertOut out;
+    int crossed = 0;
+    while (max_sheep_send - out.sheep_sent > 0 &&
+           max_wheat_receive - out.wheat_received > 0) {
+        std::string okey;
+        Entry *oe_e = best_offer(c, wheat, sheep, &okey);
+        if (oe_e == nullptr)
+            break;
+        need(crossed < MAX_OFFERS_TO_CROSS, "too many offers crossed");
+        OfferState &oe = oe_e->offer;
+        if (filter_pn > 0 &&
+            !crosses(oe.price_n, oe.price_d, filter_pn, filter_pd, false,
+                     (oe.flags & PASSIVE_FLAG) != 0))
+            break; /* price filter stop */
+        need(oe.seller != src, "crossed self");
+
+        offer_liabilities(c, oe, -1); /* release before measuring */
+
+        int64_t seller_cap = can_sell_at_most(c, oe.seller, wheat);
+        int64_t mwso = oe.amount < seller_cap ? oe.amount : seller_cap;
+        int64_t msro = can_buy_at_most(c, oe.seller, sheep);
+        int64_t adjusted =
+            adjust_offer_amount(oe.price_n, oe.price_d, mwso, msro);
+        if (adjusted == 0) {
+            erase_offer(c, *oe_e, okey);
+            crossed++;
+            continue;
+        }
+
+        ExchRes res = exchange_v10(oe.price_n, oe.price_d, adjusted,
+                                   max_wheat_receive - out.wheat_received,
+                                   max_sheep_send - out.sheep_sent,
+                                   INT64_MAX_, round_);
+        crossed++;
+
+        if (res.wheat_receive > 0) {
+            credit(c, oe.seller, wheat, -res.wheat_receive);
+            credit(c, oe.seller, sheep, res.sheep_send);
+            Atom at;
+            at.seller = oe.seller;
+            at.offer_id = oe.offerID;
+            at.asset_sold = wheat;
+            at.amount_sold = res.wheat_receive;
+            at.asset_bought = sheep;
+            at.amount_bought = res.sheep_send;
+            out.atoms.push_back(at);
+            out.sheep_sent += res.sheep_send;
+            out.wheat_received += res.wheat_receive;
+        }
+
+        if (res.wheat_stays) {
+            int64_t rem = oe.amount - res.wheat_receive;
+            int64_t cap2 = can_sell_at_most(c, oe.seller, wheat);
+            int64_t new_amount = adjust_offer_amount(
+                oe.price_n, oe.price_d, rem < cap2 ? rem : cap2,
+                can_buy_at_most(c, oe.seller, sheep));
+            if (new_amount == 0) {
+                erase_offer(c, *oe_e, okey);
+            } else {
+                mark_put(c, *oe_e, okey);
+                oe.amount = new_amount;
+                offer_liabilities(c, oe, 1);
+            }
+            break; /* taker exhausted */
+        }
+        erase_offer(c, *oe_e, okey);
+    }
+    return out;
+}
+
 static void apply_manage_sell_offer(Ctx &c, const Tx &tx, Wr &result) {
     const std::string &selling = tx.selling, &buying = tx.buying;
     need(asset_valid(selling) && asset_valid(buying), "invalid asset");
     need(selling != buying, "selling == buying");
     need(tx.price_n > 0 && tx.price_d > 0, "invalid price");
-    need(tx.amount > 0, "non-create offer shape");
+    need(tx.amount >= 0 && tx.offer_id >= 0, "malformed offer");
+    need(tx.amount > 0 || tx.offer_id != 0, "malformed offer");
+
+    if (tx.amount == 0) {
+        /* delete: no trustline prerequisites (ref checkOfferValid:38
+         * "don't bother loading trust lines as we're deleting") */
+        std::string okey = offer_key(tx.src, tx.offer_id);
+        Entry *oe_e = declared(c, okey);
+        need(oe_e->exists, "offer not found");
+        need(oe_e->kind == K_OFFER && oe_e->supported,
+             "unsupported offer shape");
+        offer_liabilities(c, oe_e->offer, -1);
+        erase_offer(c, *oe_e, okey);
+        result.u32(0);                    /* opINNER */
+        result.u32(OP_MANAGE_SELL_OFFER); /* tr disc */
+        result.u32(0);                    /* MANAGE_SELL_OFFER_SUCCESS */
+        result.u32(0);                    /* offersClaimed: [] */
+        result.u32(EFF_DELETED);          /* (void) */
+        return;
+    }
 
     /* trustline prerequisites (ref checkOfferValid order) */
     if (!asset_is_native(selling) && asset_issuer(selling) != tx.src) {
@@ -1041,9 +1289,28 @@ static void apply_manage_sell_offer(Ctx &c, const Tx &tx, Wr &result) {
         need(tl_authorized(tl->tl), "buy not authorized");
     }
 
-    /* new offer: up-front subentry reservation (0-amount dummy through
-     * create_entry_with_possible_sponsorship, unsponsored branch) */
-    {
+    bool modify = tx.offer_id != 0;
+    uint32_t existing_flags = 0;
+    if (modify) {
+        /* modify: release old liabilities + erase, but KEEP the
+         * subentry reservation (ref doApply v14+: "sellSheepOffer is
+         * deleted but sourceAccount is not updated"); the rebuilt
+         * offer keeps the loaded offer's flags — sponsored offers
+         * decline at entry parse, so no sponsor survives here */
+        std::string exkey = offer_key(tx.src, tx.offer_id);
+        Entry *ex = declared(c, exkey);
+        need(ex->exists, "offer not found");
+        need(ex->kind == K_OFFER && ex->supported,
+             "unsupported offer shape");
+        existing_flags = ex->offer.flags;
+        offer_liabilities(c, ex->offer, -1);
+        op_touch(c, exkey);
+        ex->exists = false;
+        ex->dirty = true;
+    } else {
+        /* new offer: up-front subentry reservation (0-amount dummy
+         * through create_entry_with_possible_sponsorship, unsponsored
+         * branch) */
         Entry &se = load_acct(c, tx.src, "offer source missing");
         AcctState &a = se.acct;
         need(a.numSubEntries + 1 <= ACCOUNT_SUBENTRY_LIMIT,
@@ -1064,82 +1331,21 @@ static void apply_manage_sell_offer(Ctx &c, const Tx &tx, Wr &result) {
     int64_t max_sheep_send = tx.amount < sell_cap ? tx.amount : sell_cap;
     int64_t max_wheat_receive = buy_cap;
 
-    /* crossing loop (convert_with_offers; sheep=selling, wheat=buying) */
-    int64_t sheep_sent = 0, wheat_received = 0;
-    std::vector<Atom> atoms;
-    int crossed = 0;
-    while (max_sheep_send - sheep_sent > 0 &&
-           max_wheat_receive - wheat_received > 0) {
-        std::string okey;
-        Entry *oe_e = best_offer(c, buying, selling, &okey);
-        if (oe_e == nullptr)
-            break;
-        need(crossed < MAX_OFFERS_TO_CROSS, "too many offers crossed");
-        OfferState &oe = oe_e->offer;
-        if (!crosses(oe.price_n, oe.price_d, tx.price_n, tx.price_d, false,
-                     (oe.flags & PASSIVE_FLAG) != 0))
-            break; /* price filter stop */
-        need(oe.seller != tx.src, "crossed self");
-
-        offer_liabilities(c, oe, -1); /* release before measuring */
-
-        int64_t seller_cap = can_sell_at_most(c, oe.seller, buying);
-        int64_t mwso = oe.amount < seller_cap ? oe.amount : seller_cap;
-        int64_t msro = can_buy_at_most(c, oe.seller, selling);
-        int64_t adjusted =
-            adjust_offer_amount(oe.price_n, oe.price_d, mwso, msro);
-        if (adjusted == 0) {
-            erase_offer(c, *oe_e, okey);
-            crossed++;
-            continue;
-        }
-
-        ExchRes res = exchange_v10(oe.price_n, oe.price_d, adjusted,
-                                   max_wheat_receive - wheat_received,
-                                   max_sheep_send - sheep_sent, INT64_MAX_);
-        crossed++;
-
-        if (res.wheat_receive > 0) {
-            credit(c, oe.seller, buying, -res.wheat_receive);
-            credit(c, oe.seller, selling, res.sheep_send);
-            Atom at;
-            at.seller = oe.seller;
-            at.offer_id = oe.offerID;
-            at.asset_sold = buying;
-            at.amount_sold = res.wheat_receive;
-            at.asset_bought = selling;
-            at.amount_bought = res.sheep_send;
-            atoms.push_back(at);
-            sheep_sent += res.sheep_send;
-            wheat_received += res.wheat_receive;
-        }
-
-        if (res.wheat_stays) {
-            int64_t rem = oe.amount - res.wheat_receive;
-            int64_t cap2 = can_sell_at_most(c, oe.seller, buying);
-            int64_t new_amount = adjust_offer_amount(
-                oe.price_n, oe.price_d, rem < cap2 ? rem : cap2,
-                can_buy_at_most(c, oe.seller, selling));
-            if (new_amount == 0) {
-                erase_offer(c, *oe_e, okey);
-            } else {
-                mark_put(c, *oe_e, okey);
-                oe.amount = new_amount;
-                offer_liabilities(c, oe, 1);
-            }
-            break; /* taker exhausted */
-        }
-        erase_offer(c, *oe_e, okey);
-    }
+    /* crossing loop (sheep=selling, wheat=buying; own offer is never
+     * passive here — CREATE_PASSIVE_SELL_OFFER stays host-side) */
+    ConvertOut cv = convert_with_offers(c, tx.src, selling, max_sheep_send,
+                                        buying, max_wheat_receive,
+                                        ROUND_NORMAL, tx.price_n,
+                                        tx.price_d);
 
     /* settle the taker's side */
-    if (sheep_sent > 0)
-        credit(c, tx.src, selling, -sheep_sent);
-    if (wheat_received > 0)
-        credit(c, tx.src, buying, wheat_received);
+    if (cv.sheep_sent > 0)
+        credit(c, tx.src, selling, -cv.sheep_sent);
+    if (cv.wheat_received > 0)
+        credit(c, tx.src, buying, cv.wheat_received);
 
     /* residual resting amount, re-adjusted post-settle */
-    int64_t rem = tx.amount - sheep_sent;
+    int64_t rem = tx.amount - cv.sheep_sent;
     int64_t cap = can_sell_at_most(c, tx.src, selling);
     int64_t sheep_limit = rem < cap ? rem : cap;
     int64_t wheat_limit = can_buy_at_most(c, tx.src, buying);
@@ -1150,32 +1356,33 @@ static void apply_manage_sell_offer(Ctx &c, const Tx &tx, Wr &result) {
     result.u32(0);                    /* opINNER */
     result.u32(OP_MANAGE_SELL_OFFER); /* tr disc */
     result.u32(0);                    /* MANAGE_SELL_OFFER_SUCCESS */
-    result.u32((uint32_t)atoms.size());
-    for (const Atom &at : atoms) {
-        result.u32(1); /* CLAIM_ATOM_TYPE_ORDER_BOOK */
-        result.u32(0); /* sellerID pk disc */
-        result.raw(at.seller);
-        result.i64(at.offer_id);
-        result.raw(at.asset_sold);
-        result.i64(at.amount_sold);
-        result.raw(at.asset_bought);
-        result.i64(at.amount_bought);
-    }
+    emit_claim_atoms(result, cv.atoms);
 
     if (amount_left <= 0) {
-        /* nothing rests: refund the up-front subentry reservation */
+        /* nothing rests: give back the subentry reservation — for a
+         * modify too (the ghost remove_entry_with_possible_sponsorship
+         * on the 0-amount offer) */
         Entry &se = load_acct(c, tx.src, "offer source missing");
         need(se.acct.numSubEntries >= 1, "invalid account state");
         mark_put(c, se, account_key(tx.src));
         se.acct.numSubEntries -= 1;
-        result.u32(2); /* MANAGE_OFFER_DELETED (void) */
+        result.u32(EFF_DELETED); /* (void) */
         return;
     }
 
-    /* write the resting offer; allocate from the id pool */
-    need(c.idpool < INT64_MAX_, "id pool saturated");
-    int64_t new_id = c.idpool + 1;
-    c.idpool = new_id;
+    /* write the resting offer: a modify keeps its id and flags, a
+     * create allocates from the id pool */
+    int64_t new_id;
+    uint32_t flags;
+    if (modify) {
+        new_id = tx.offer_id;
+        flags = existing_flags;
+    } else {
+        need(c.idpool < INT64_MAX_, "id pool saturated");
+        new_id = c.idpool + 1;
+        c.idpool = new_id;
+        flags = 0;
+    }
     OfferState no;
     no.seller = tx.src;
     no.offerID = new_id;
@@ -1184,7 +1391,7 @@ static void apply_manage_sell_offer(Ctx &c, const Tx &tx, Wr &result) {
     no.amount = amount_left;
     no.price_n = tx.price_n;
     no.price_d = tx.price_d;
-    no.flags = 0;
+    no.flags = flags;
     std::string nkey = offer_key(tx.src, new_id);
     need(find_entry(c, nkey) == nullptr || !c.store[nkey].exists,
          "fresh offer key collision");
@@ -1194,8 +1401,185 @@ static void apply_manage_sell_offer(Ctx &c, const Tx &tx, Wr &result) {
     ne.offer = no;
     mark_put(c, ne, nkey);
     offer_liabilities(c, ne.offer, 1);
-    result.u32(0); /* MANAGE_OFFER_CREATED */
+    result.u32(modify ? EFF_UPDATED : EFF_CREATED);
     encode_offer_value(ne.offer, result);
+}
+
+/* ------------------------------------------------------ change_trust */
+
+static void apply_change_trust(Ctx &c, const Tx &tx) {
+    const std::string &line = tx.asset;
+    need(asset_valid(line) && !asset_is_native(line),
+         "change trust malformed");
+    std::string issuer = asset_issuer(line);
+    need(issuer != tx.src, "change trust self not allowed");
+    need(tx.limit >= 0, "change trust malformed");
+
+    std::string tlkey = trustline_key(tx.src, line);
+    Entry *t = declared(c, tlkey);
+    if (t->exists) {
+        need(t->kind == K_TL && t->supported,
+             "unsupported trustline shape");
+        TlState &tl = t->tl;
+        if (tx.limit != 0) {
+            /* limit update (ref ChangeTrustOpFrame::doApply) */
+            need(tx.limit >= tl.balance + tl.liab_buying,
+                 "change trust invalid limit");
+            need(load_acct_opt(c, issuer) != nullptr,
+                 "change trust no issuer");
+            mark_put(c, *t, tlkey);
+            tl.limit = tx.limit;
+            return;
+        }
+        /* delete: only an empty, liability-free, pool-free line goes */
+        need(tl.balance == 0, "change trust invalid limit");
+        need(tl.liab_buying == 0 && tl.liab_selling == 0,
+             "change trust cannot delete");
+        need(tl.pool_use_count == 0, "change trust cannot delete");
+        op_touch(c, tlkey);
+        t->exists = false;
+        t->dirty = true;
+        /* unsponsored remove: the owner's subentry reserve returns */
+        Entry &owner = load_acct(c, tx.src, "trust source missing");
+        need(owner.acct.numSubEntries >= 1, "invalid account state");
+        mark_put(c, owner, account_key(tx.src));
+        owner.acct.numSubEntries -= 1;
+        return;
+    }
+
+    /* new trustline: flags derive from the issuer's account flags */
+    need(tx.limit != 0, "change trust invalid limit");
+    Entry *ie = load_acct_opt(c, issuer);
+    need(ie != nullptr, "change trust no issuer");
+    uint32_t flags = 0;
+    if (!(ie->acct.flags & ACC_AUTH_REQUIRED_FLAG))
+        flags |= AUTHORIZED_FLAG;
+    if (ie->acct.flags & ACC_AUTH_CLAWBACK_FLAG)
+        flags |= TL_CLAWBACK_FLAG;
+    /* unsponsored create: the owner pays the subentry reserve */
+    {
+        Entry &owner = load_acct(c, tx.src, "trust source missing");
+        AcctState &a = owner.acct;
+        need(a.numSubEntries + 1 <= ACCOUNT_SUBENTRY_LIMIT,
+             "too many subentries");
+        need(available_balance(c, a) >= c.base_reserve, "low reserve");
+        mark_put(c, owner, account_key(tx.src));
+        a.numSubEntries += 1;
+    }
+    TlState tl;
+    tl.account = tx.src;
+    tl.asset = line;
+    tl.balance = 0;
+    tl.limit = tx.limit;
+    tl.flags = flags;
+    t->kind = K_TL;
+    t->supported = true;
+    t->tl = tl;
+    mark_put(c, *t, tlkey);
+}
+
+/* opINNER(CHANGE_TRUST, CHANGE_TRUST_SUCCESS) */
+static void change_trust_result(Wr &w) {
+    w.u32(0);               /* opINNER */
+    w.u32(OP_CHANGE_TRUST); /* OperationResultTr disc */
+    w.u32(0);               /* CHANGE_TRUST_SUCCESS (void arm) */
+}
+
+/* ---------------------------------------------------- path payments */
+
+static void check_hop_pool_absent(Ctx &c, const Hop &hop) {
+    /* pool quoting (convert_with_offers_and_pools) stays host-side: a
+     * LIVE pool on the pair can win the route, so the kernel declines
+     * and the Python reference adjudicates.  The pool key rides the
+     * footprint's book materialization, so it is always declared. */
+    Entry *pe = declared(c, hop.pool_key);
+    need(!pe->exists, "liquidity pool on hop");
+}
+
+static void apply_path_payment(Ctx &c, const Tx &tx, Wr &result) {
+    bool strict_send = tx.op == OP_PATH_PAYMENT_STRICT_SEND;
+    /* tx.amount = sendAmount | sendMax; tx.amount2 = destMin |
+     * destAmount (strict send | strict receive) */
+    need(tx.amount > 0 && tx.amount2 > 0, "path payment malformed");
+    need(asset_valid(tx.asset) && asset_valid(tx.dest_asset),
+         "path payment malformed");
+    need((int)tx.hops.size() <= MAX_PATH_HOPS, "path too long");
+    for (const Hop &h : tx.hops)
+        need(asset_valid(h.selling) && asset_valid(h.buying),
+             "path payment malformed");
+
+    /* destination existence + dest/src trust gates (every failure is a
+     * failure result host-side; the walk never touches the source's
+     * own lines — sellers are never the taker — so check placement is
+     * not state-visible on success paths) */
+    need(load_acct_opt(c, tx.dest) != nullptr, "path no destination");
+    if (!asset_is_native(tx.dest_asset) &&
+        asset_issuer(tx.dest_asset) != tx.dest) {
+        Entry *dt = load_tl_opt(c, tx.dest, tx.dest_asset);
+        need(dt != nullptr, "path no trust");
+        need(tl_authorized(dt->tl), "path not authorized");
+    }
+    if (!asset_is_native(tx.asset) && asset_issuer(tx.asset) != tx.src) {
+        Entry *st = load_tl_opt(c, tx.src, tx.asset);
+        need(st != nullptr, "path src no trust");
+        need(tl_authorized(st->tl), "path src not authorized");
+    }
+
+    std::vector<Atom> atoms;
+    int64_t send_amount, dest_amount;
+    if (strict_send) {
+        /* forward walk: propagate what each hop yields */
+        int64_t have = tx.amount;
+        for (size_t i = 0; i < tx.hops.size(); i++) {
+            const Hop &hop = tx.hops[i];
+            check_hop_pool_absent(c, hop);
+            ConvertOut out = convert_with_offers(
+                c, tx.src, hop.selling, have, hop.buying, INT64_MAX_,
+                ROUND_PP_STRICT_SEND, 0, 0);
+            need(out.sheep_sent >= have, "too few offers");
+            atoms.insert(atoms.end(), out.atoms.begin(),
+                         out.atoms.end());
+            have = out.wheat_received;
+        }
+        send_amount = tx.amount;
+        dest_amount = have;
+        need(dest_amount >= tx.amount2, "under destmin");
+    } else {
+        /* backward walk: compute what each hop requires */
+        int64_t needed = tx.amount2;
+        for (size_t i = tx.hops.size(); i-- > 0;) {
+            const Hop &hop = tx.hops[i];
+            check_hop_pool_absent(c, hop);
+            ConvertOut out = convert_with_offers(
+                c, tx.src, hop.selling, INT64_MAX_, hop.buying, needed,
+                ROUND_PP_STRICT_RECEIVE, 0, 0);
+            need(out.wheat_received >= needed, "too few offers");
+            atoms.insert(atoms.begin(), out.atoms.begin(),
+                         out.atoms.end());
+            needed = out.sheep_sent;
+        }
+        send_amount = needed;
+        dest_amount = tx.amount2;
+        need(send_amount <= tx.amount, "over sendmax");
+    }
+
+    if (asset_is_native(tx.asset)) {
+        Entry &se = load_acct(c, tx.src, "path source missing");
+        need(send_amount <= available_balance(c, se.acct),
+             "path underfunded");
+    }
+    credit(c, tx.src, tx.asset, -send_amount);
+    credit(c, tx.dest, tx.dest_asset, dest_amount);
+
+    /* opINNER(type, SUCCESS, {offers, last: SimplePaymentResult}) */
+    result.u32(0);                 /* opINNER */
+    result.u32((uint32_t)tx.op);   /* OperationResultTr disc */
+    result.u32(0);                 /* *_SUCCESS */
+    emit_claim_atoms(result, atoms);
+    result.u32(0); /* last.destination pk disc */
+    result.raw(tx.dest);
+    result.raw(tx.dest_asset);
+    result.i64(dest_amount);
 }
 
 /* -------------------------------------------------------- tx driver */
@@ -1228,6 +1612,12 @@ static void run_tx(Ctx &c, size_t idx) {
         payment_result(opres);
     } else if (tx.op == OP_MANAGE_SELL_OFFER) {
         apply_manage_sell_offer(c, tx, opres);
+    } else if (tx.op == OP_CHANGE_TRUST) {
+        apply_change_trust(c, tx);
+        change_trust_result(opres);
+    } else if (tx.op == OP_PATH_PAYMENT_STRICT_SEND ||
+               tx.op == OP_PATH_PAYMENT_STRICT_RECEIVE) {
+        apply_path_payment(c, tx, opres);
     } else {
         throw Decline("unsupported op type");
     }
@@ -1372,6 +1762,11 @@ static PyObject *apply_cluster(PyObject *self, PyObject *args) {
                 return NULL;
             }
             tx.amount = PyLong_AsLongLong(PyTuple_GetItem(it, 7));
+            if (parse_bytes(PyTuple_GetItem(it, 8), tx.asset,
+                            "payment asset") < 0) {
+                Py_DECREF(seq);
+                return NULL;
+            }
         } else if (op == OP_MANAGE_SELL_OFFER) {
             if (parse_bytes(PyTuple_GetItem(it, 6), tx.selling,
                             "offer selling") < 0 ||
@@ -1383,6 +1778,53 @@ static PyObject *apply_cluster(PyObject *self, PyObject *args) {
             tx.amount = PyLong_AsLongLong(PyTuple_GetItem(it, 8));
             tx.price_n = (int32_t)PyLong_AsLong(PyTuple_GetItem(it, 9));
             tx.price_d = (int32_t)PyLong_AsLong(PyTuple_GetItem(it, 10));
+            tx.offer_id = PyLong_AsLongLong(PyTuple_GetItem(it, 11));
+        } else if (op == OP_CHANGE_TRUST) {
+            if (parse_bytes(PyTuple_GetItem(it, 6), tx.asset,
+                            "trust line asset") < 0) {
+                Py_DECREF(seq);
+                return NULL;
+            }
+            tx.limit = PyLong_AsLongLong(PyTuple_GetItem(it, 7));
+        } else if (op == OP_PATH_PAYMENT_STRICT_SEND ||
+                   op == OP_PATH_PAYMENT_STRICT_RECEIVE) {
+            if (parse_bytes(PyTuple_GetItem(it, 6), tx.dest,
+                            "path dest") < 0 ||
+                parse_bytes(PyTuple_GetItem(it, 7), tx.asset,
+                            "path send asset") < 0) {
+                Py_DECREF(seq);
+                return NULL;
+            }
+            tx.amount = PyLong_AsLongLong(PyTuple_GetItem(it, 8));
+            if (parse_bytes(PyTuple_GetItem(it, 9), tx.dest_asset,
+                            "path dest asset") < 0) {
+                Py_DECREF(seq);
+                return NULL;
+            }
+            tx.amount2 = PyLong_AsLongLong(PyTuple_GetItem(it, 10));
+            PyObject *hops = PySequence_Fast(
+                PyTuple_GetItem(it, 11), "path hops must be a sequence");
+            if (!hops) {
+                Py_DECREF(seq);
+                return NULL;
+            }
+            for (Py_ssize_t j = 0; j < PySequence_Fast_GET_SIZE(hops);
+                 j++) {
+                PyObject *ht = PySequence_Fast_GET_ITEM(hops, j);
+                Hop hop;
+                if (parse_bytes(PyTuple_GetItem(ht, 0), hop.selling,
+                                "hop selling") < 0 ||
+                    parse_bytes(PyTuple_GetItem(ht, 1), hop.buying,
+                                "hop buying") < 0 ||
+                    parse_bytes(PyTuple_GetItem(ht, 2), hop.pool_key,
+                                "hop pool key") < 0) {
+                    Py_DECREF(hops);
+                    Py_DECREF(seq);
+                    return NULL;
+                }
+                tx.hops.push_back(hop);
+            }
+            Py_DECREF(hops);
         } else {
             Py_DECREF(seq);
             PyErr_SetString(KernelError, "unsupported op type in tx strip");

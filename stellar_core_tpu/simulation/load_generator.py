@@ -33,7 +33,9 @@ class LoadGenerator:
         self._rate_timer = None
         self._rate_state: Optional[dict] = None
         # payment destination graph: "ring" (i pays i+1; one conflict
-        # component) or "pairs" (2j <-> 2j+1; disjoint account pairs)
+        # component), "pairs" (2j <-> 2j+1; disjoint account pairs), or
+        # "credit" (pairs graph, but payments move the LOAD credit
+        # asset over trustlines — setup_dex() first)
         self.payment_pattern = "ring"
 
     # -- deterministic account derivation -----------------------------------
@@ -104,13 +106,14 @@ class LoadGenerator:
         return self._seqs[k]
 
     def payment_envelope(self, src: SecretKey, dest: bytes, amount: int,
-                         fee: int = 100):
+                         fee: int = 100, asset=None):
         op = T.Operation.make(
             sourceAccount=None,
             body=T.OperationBody.make(
                 T.OperationType.PAYMENT,
                 T.PaymentOp.make(destination=T.muxed_account(dest),
-                                 asset=U.asset_native(),
+                                 asset=asset if asset is not None
+                                 else U.asset_native(),
                                  amount=amount)))
         return self._sign_tx(src, [op], fee)
 
@@ -130,7 +133,7 @@ class LoadGenerator:
         if dest_accounts is not None:
             return dest_accounts[i % len(dest_accounts)].public_key().raw
         k = len(accts)
-        if self.payment_pattern == "pairs":
+        if self.payment_pattern in ("pairs", "credit"):
             j = i % k
             p = j ^ 1
             if p >= k:
@@ -147,12 +150,18 @@ class LoadGenerator:
         tracked per source)."""
         accts = accounts or self.accounts
         assert accts, "CREATE accounts first"
+        asset = None
+        if self.payment_pattern == "credit":
+            assert getattr(self, "dex_asset", None) is not None, \
+                "setup_dex() first for payment_pattern='credit'"
+            asset = self.dex_asset
         out = []
         k = len(accts)
         for i in range(n):
             src = accts[i % k]
             dest = self._payment_dest(accts, i, dest_accounts)
-            out.append(self.payment_envelope(src, dest, 1 + (i % 1000)))
+            out.append(self.payment_envelope(src, dest, 1 + (i % 1000),
+                                             asset=asset))
         return out
 
     # -- PRETEND mode -------------------------------------------------------
@@ -231,18 +240,37 @@ class LoadGenerator:
             ltx.commit()
 
     def offer_envelope(self, src: SecretKey, amount: int,
-                       price_n: int, price_d: int, fee: int = 100):
-        """Sell native for the LOAD asset (ref manageOfferTransaction —
-        every generated offer is new, offerID=0)."""
+                       price_n: int, price_d: int, fee: int = 100,
+                       selling=None, buying=None, offer_id: int = 0):
+        """ManageSellOffer envelope; default shape sells native for the
+        LOAD asset as a NEW offer (ref manageOfferTransaction), but any
+        pair / offerID works — offer_id != 0 with amount > 0 is a
+        modify, with amount == 0 a delete."""
         op = T.Operation.make(
             sourceAccount=None,
             body=T.OperationBody.make(
                 T.OperationType.MANAGE_SELL_OFFER,
                 T.ManageSellOfferOp.make(
-                    selling=U.asset_native(), buying=self.dex_asset,
+                    selling=selling if selling is not None
+                    else U.asset_native(),
+                    buying=buying if buying is not None
+                    else self.dex_asset,
                     amount=amount,
                     price=T.Price.make(n=price_n, d=price_d),
-                    offerID=0)))
+                    offerID=offer_id)))
+        return self._sign_tx(src, [op], fee)
+
+    def changetrust_envelope(self, src: SecretKey, asset,
+                             limit: int = U.INT64_MAX, fee: int = 100):
+        """ChangeTrust envelope over a classic asset (create when no
+        line exists, limit update when one does, delete at limit=0)."""
+        op = T.Operation.make(
+            sourceAccount=None,
+            body=T.OperationBody.make(
+                T.OperationType.CHANGE_TRUST,
+                T.ChangeTrustOp.make(
+                    line=T.ChangeTrustAsset.make(asset.type, asset.value),
+                    limit=limit)))
         return self._sign_tx(src, [op], fee)
 
     def generate_mixed(self, n: int, dex_percent: int = 50,
@@ -269,6 +297,280 @@ class LoadGenerator:
                 dest = self._payment_dest(accts, i, dest_accounts)
                 out.append(self.payment_envelope(src, dest,
                                                  1 + (i % 1000)))
+        return out
+
+    # -- CREDIT mode (ISSUE 13: credit-heavy realistic traffic) -------------
+
+    def _derive_credit2(self) -> None:
+        issuer2 = SecretKey(sha256(b"loadgen-credit-issuer2"))
+        self.credit2_issuer = issuer2
+        self.credit2_asset = U.make_asset(b"CRD2",
+                                          issuer2.public_key().raw)
+
+    def setup_credit(self, accounts: Optional[List[SecretKey]] = None,
+                     credit: int = 10**7) -> None:
+        """Seed the credit-mix workload: the LOAD issuer + funded
+        trustlines (setup_dex) plus a SECOND issuer/asset (CRD2) whose
+        trustlines the workload creates and resizes through real
+        changeTrust transactions — the trustline create/update kernel
+        surface."""
+        self.setup_dex(accounts=accounts, credit=credit)
+        self._derive_credit2()
+        root = self.app.ledger_manager.root
+        with LedgerTxn(root) as ltx:
+            if ltx.load_account(self.credit2_issuer.public_key().raw) \
+                    is None:
+                ltx.put(U.make_account_entry(
+                    self.credit2_issuer.public_key().raw, 10**9,
+                    seq_num=0))
+            ltx.commit()
+
+    def create_credit_issuer_envelopes(self) -> List:
+        """Stage A of TX-BASED credit-mix seeding (the HTTP
+        generateload path, state-commitment-safe): create the LOAD and
+        CRD2 issuers from the network root — their own close, so later
+        trustlines cannot race them."""
+        root = self.root_key()
+        self._derive_dex()
+        self._derive_credit2()
+        envs = []
+        for issuer in (self.dex_issuer, self.credit2_issuer):
+            envs.append(self._sign_tx(root, [T.Operation.make(
+                sourceAccount=None,
+                body=T.OperationBody.make(
+                    T.OperationType.CREATE_ACCOUNT,
+                    T.CreateAccountOp.make(
+                        destination=T.account_id(
+                            issuer.public_key().raw),
+                        startingBalance=10**9)))], 100))
+        return envs
+
+    def generate_credit_mix(self, n: int, trust_pct: int = 10,
+                            accounts: Optional[List[SecretKey]] = None
+                            ) -> List:
+        """Credit-heavy close shape: LOAD-asset payments over disjoint
+        account pairs, salted with ``trust_pct``% changeTrust ops on the
+        CRD2 asset (first touch creates the line, later touches resize
+        its limit) — the credit/trustline op families real Stellar
+        traffic is dominated by, in conflict shapes the planner can
+        spread.  Deterministic pseudo-mix like generate_mixed."""
+        accts = accounts or self.accounts
+        assert accts, "CREATE accounts first"
+        assert getattr(self, "credit2_asset", None) is not None, \
+            "setup_credit() first"
+        prev_pattern = self.payment_pattern
+        self.payment_pattern = "credit"
+        out = []
+        k = len(accts)
+        try:
+            for i in range(n):
+                src = accts[i % k]
+                if (i * 7919 + 13) % 100 < trust_pct:
+                    # vary the limit so repeat touches are real updates
+                    limit = U.INT64_MAX - (i % 5)
+                    out.append(self.changetrust_envelope(
+                        src, self.credit2_asset, limit))
+                else:
+                    dest = self._payment_dest(accts, i, None)
+                    out.append(self.payment_envelope(
+                        src, dest, 1 + (i % 1000),
+                        asset=self.dex_asset))
+        finally:
+            self.payment_pattern = prev_pattern
+        return out
+
+    # -- PATHPAY mode (ISSUE 13: multi-hop conversion chains) ---------------
+
+    def _derive_path(self, hops: int, makers: int):
+        """Deterministic issuers/assets/makers of the ``hops``-hop
+        chain (shared by the bulk seeder and the tx-based stages)."""
+        assert 1 <= hops <= 3, "path workloads support 1-3 hops"
+        names = [b"PATHA", b"PATHB", b"PATHC"][:hops]
+        issuers = [SecretKey(sha256(b"loadgen-path-issuer-" + nm))
+                   for nm in names]
+        assets = [U.make_asset(nm, sk.public_key().raw)
+                  for nm, sk in zip(names, issuers)]
+        maker_keys = [self.account_key(j, b"pathmaker")
+                      for j in range(makers)]
+        self.path_issuers = issuers
+        self.path_assets = assets
+        self.path_makers = maker_keys
+        return issuers, assets, maker_keys
+
+    def path_stage_envelopes(self, stage: int, hops: int = 2,
+                             makers: int = 8,
+                             maker_credit: int = 10**12,
+                             offer_amount: int = 10**10) -> List:
+        """TX-BASED pathpay seeding for the HTTP generateload path —
+        four stages, one ledger close between each (the returned
+        envelopes must all be admitted before advancing):
+
+        0. network root creates the hop issuers + maker accounts;
+        1. trustlines: makers trust every hop asset, every generator
+           account trusts the FINAL asset (the recipients);
+        2. issuers fund the makers in their asset;
+        3. makers post the hop offers (selling hop asset i for the
+           previous chain asset, native first) — the seeded books.
+        """
+        issuers, assets, maker_keys = self._derive_path(hops, makers)
+        root = self.root_key()
+        if stage == 0:
+            ops = [T.Operation.make(
+                sourceAccount=None,
+                body=T.OperationBody.make(
+                    T.OperationType.CREATE_ACCOUNT,
+                    T.CreateAccountOp.make(
+                        destination=T.account_id(sk.public_key().raw),
+                        startingBalance=10**9)))
+                for sk in (*issuers, *maker_keys)]
+            return [self._sign_tx(root, ops, 100 * len(ops))]
+        if stage == 1:
+            envs = []
+            for mk in maker_keys:
+                for asset in assets:
+                    envs.append(self.changetrust_envelope(mk, asset))
+            final = assets[-1]
+            for sk in self.accounts:
+                envs.append(self.changetrust_envelope(sk, final))
+            return envs
+        if stage == 2:
+            envs = []
+            for issuer, asset in zip(issuers, assets):
+                ops = [T.Operation.make(
+                    sourceAccount=None,
+                    body=T.OperationBody.make(
+                        T.OperationType.PAYMENT,
+                        T.PaymentOp.make(
+                            destination=T.muxed_account(
+                                mk.public_key().raw),
+                            asset=asset, amount=maker_credit)))
+                    for mk in maker_keys]
+                envs.append(self._sign_tx(issuer, ops, 100 * len(ops)))
+            return envs
+        assert stage == 3, f"unknown path stage {stage}"
+        return self._maker_offer_envelopes(assets, maker_keys,
+                                           offer_amount)
+
+    def _maker_offer_envelopes(self, assets, maker_keys,
+                               offer_amount: int) -> List:
+        """The seeded hop books: each maker sells hop asset i for the
+        previous chain asset (native first) at 1:1, deep amounts so
+        thousands of small path payments shave offers without
+        exhausting a book.  One builder for BOTH seeding paths (bulk
+        setup_path and the tx-based HTTP stages) so the two workloads
+        can never drift apart."""
+        envs = []
+        chain_buy = [U.asset_native(), *assets[:-1]]
+        for mk in maker_keys:
+            for selling, buying in zip(assets, chain_buy):
+                envs.append(self.offer_envelope(
+                    mk, offer_amount, 1, 1, selling=selling,
+                    buying=buying))
+        return envs
+
+    def setup_path(self, hops: int = 2, makers: int = 8,
+                   maker_credit: int = 10**15,
+                   offer_amount: int = 10**12) -> List:
+        """Seed ``hops``-hop path-payment books: one issuer+asset per
+        chain step (PATHA, PATHB, PATHC...), maker accounts holding
+        deep balances in every step asset, and trustlines in the FINAL
+        asset for every generator account (they are the recipients).
+
+        Seeding writes accounts/trustlines in bulk (perf-rig style,
+        like setup_dex) but returns the market-maker OFFER envelopes
+        for the caller to admit + close: resting offers carry
+        liabilities and consume offer ids, so they must flow through
+        the real close path to keep the id pool and reserve accounting
+        consistent.  Chain: native -> PATHA [-> PATHB ...] -> final."""
+        assert self.accounts, "CREATE accounts first"
+        issuers, assets, maker_keys = self._derive_path(hops, makers)
+        root = self.app.ledger_manager.root
+        with LedgerTxn(root) as ltx:
+            for sk in issuers:
+                if ltx.load_account(sk.public_key().raw) is None:
+                    ltx.put(U.make_account_entry(
+                        sk.public_key().raw, 10**9, seq_num=0))
+            for mk in maker_keys:
+                pub = mk.public_key().raw
+                if ltx.load_account(pub) is None:
+                    ltx.put(U.make_account_entry(pub, 10**9, seq_num=0))
+                subentries = 0
+                for asset in assets:
+                    if ltx.load_trustline(pub, asset) is None:
+                        ltx.put(U.make_trustline_entry(
+                            pub, asset, balance=maker_credit,
+                            limit=U.INT64_MAX))
+                        subentries += 1
+                if subentries:
+                    e = ltx.load_account(pub)
+                    acc = e.data.value
+                    ltx.put(e._replace(data=T.LedgerEntryData.make(
+                        T.LedgerEntryType.ACCOUNT,
+                        acc._replace(numSubEntries=acc.numSubEntries
+                                     + subentries))))
+            final = assets[-1]
+            for sk in self.accounts:
+                pub = sk.public_key().raw
+                if ltx.load_trustline(pub, final) is None:
+                    ltx.put(U.make_trustline_entry(
+                        pub, final, balance=0, limit=U.INT64_MAX))
+                    e = ltx.load_account(pub)
+                    acc = e.data.value
+                    ltx.put(e._replace(data=T.LedgerEntryData.make(
+                        T.LedgerEntryType.ACCOUNT,
+                        acc._replace(
+                            numSubEntries=acc.numSubEntries + 1))))
+            ltx.commit()
+        return self._maker_offer_envelopes(assets, maker_keys,
+                                           offer_amount)
+
+    def path_payment_envelope(self, src: SecretKey, dest: bytes,
+                              amount: int, strict_send: bool = True,
+                              fee: int = 100):
+        """One path payment over the seeded chain: native in, the final
+        path asset out, intermediate assets as the declared path."""
+        assets = self.path_assets
+        path = assets[:-1]
+        dest_asset = assets[-1]
+        if strict_send:
+            body = T.PathPaymentStrictSendOp.make(
+                sendAsset=U.asset_native(), sendAmount=amount,
+                destination=T.muxed_account(dest), destAsset=dest_asset,
+                destMin=1, path=path)
+            op_type = T.OperationType.PATH_PAYMENT_STRICT_SEND
+        else:
+            body = T.PathPaymentStrictReceiveOp.make(
+                sendAsset=U.asset_native(), sendMax=4 * amount + 100,
+                destination=T.muxed_account(dest), destAsset=dest_asset,
+                destAmount=amount, path=path)
+            op_type = T.OperationType.PATH_PAYMENT_STRICT_RECEIVE
+        op = T.Operation.make(
+            sourceAccount=None,
+            body=T.OperationBody.make(op_type, body))
+        return self._sign_tx(src, [op], fee)
+
+    def generate_path_payments(self, n: int,
+                               accounts: Optional[List[SecretKey]] = None
+                               ) -> List:
+        """n path payments over the seeded books, alternating
+        strict-send / strict-receive, destinations on the disjoint
+        pairs graph (sources are never makers, so self-crossing cannot
+        fire)."""
+        accts = accounts or self.accounts
+        assert accts, "CREATE accounts first"
+        assert getattr(self, "path_assets", None) is not None, \
+            "setup_path() first"
+        out = []
+        k = len(accts)
+        for i in range(n):
+            src = accts[i % k]
+            j = i % k
+            p = j ^ 1
+            if p >= k:
+                p = j
+            dest = accts[p].public_key().raw
+            out.append(self.path_payment_envelope(
+                src, dest, 1 + (i % 500), strict_send=(i % 2 == 0)))
         return out
 
     # -- RATE mode (timer-driven tx/s; ref LoadGenerator.h:28-36) -----------

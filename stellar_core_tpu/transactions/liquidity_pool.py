@@ -68,6 +68,23 @@ def pool_key(pool_id: bytes):
     return T.LedgerKey.make(T.LedgerEntryType.LIQUIDITY_POOL, arm)
 
 
+def pair_pool_key_bytes(asset_x, asset_y) -> bytes:
+    """Canonical pool LedgerKey bytes for the (unordered) classic-asset
+    pair.  Shared by the footprint's book materialization and the
+    native-apply dispatcher's per-hop pool descriptors: the kernel's
+    decline-if-live pool probe must derive the exact key the footprint
+    declared, so both sides call THIS function."""
+    from ..ledger.ledger_txn import key_bytes
+
+    a, b = ((asset_x, asset_y) if compare_assets(asset_x, asset_y) < 0
+            else (asset_y, asset_x))
+    params = T.LiquidityPoolParameters.make(
+        T.LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT,
+        T.LiquidityPoolConstantProductParameters.make(
+            assetA=a, assetB=b, fee=T.LIQUIDITY_POOL_FEE_V18))
+    return key_bytes(pool_key(pool_id_from_params(params)))
+
+
 def load_pool(ltx, pool_id: bytes):
     return ltx.load(pool_key(pool_id))
 

@@ -17,6 +17,10 @@ MAX_SEQ = INT64_MAX
 TX_MAX_OPS = 100
 ACCOUNT_SUBENTRY_LIMIT = 1000
 MAX_OFFERS_TO_CROSS = 1000
+# longest effective path-payment conversion chain: 5 path entries plus
+# the send and dest assets = 6 hops (xdr VarArray(Asset, 5) path bound);
+# the native kernel hardcodes its twin (MAX_PATH_HOPS, lockstep-pinned)
+MAX_PATH_HOPS = 6
 
 
 # -- thresholds --------------------------------------------------------------

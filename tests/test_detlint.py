@@ -509,6 +509,27 @@ def test_injected_constant_drift_is_caught():
     assert any(f.rule == "native-lockstep" for f in fresh)
 
 
+def test_issue13_kernel_constant_drift_is_caught():
+    """The ISSUE-13 constants (path hop cap, trustline flag masks,
+    liability XDR tags) are lockstep-pinned: a one-character C++ edit
+    on any of them is red."""
+    for frm, to, name in (
+            ("MAX_PATH_HOPS = 6", "MAX_PATH_HOPS = 7", "max-path-hops"),
+            ("TL_CLAWBACK_FLAG = 4", "TL_CLAWBACK_FLAG = 5",
+             "trustline-clawback-flag"),
+            ("TL_V1_EXT_V2 = 2", "TL_V1_EXT_V2 = 3",
+             "trustline-v1-ext-v2-tag"),
+            ("OP_CHANGE_TRUST = 6", "OP_CHANGE_TRUST = 7",
+             "op-change-trust")):
+        drifted = _kernel_source().replace(frm, to)
+        assert drifted != _kernel_source(), frm
+        hits = [f for f in lint_sources({KERNEL: drifted})
+                if f.rule == "native-lockstep"]
+        assert hits, f"{name}: drift must fail the gate"
+        assert any(name in f.message for f in hits), \
+            [f.render() for f in hits]
+
+
 def test_python_side_constant_drift_is_caught():
     """The same entry fails when the PYTHON twin drifts instead."""
     path = "stellar_core_tpu/transactions/utils.py"

@@ -219,6 +219,274 @@ def test_single_cluster_ring_goes_native_inline():
     assert stats["native_hits"] > 0, stats
 
 
+# -- ISSUE 13: kernel-complete apply (credit / trust / path / modify) --------
+
+def _hit_rate(stats) -> float:
+    clusters = stats["native_hits"] + stats["native_declines"] + \
+        stats["native_off"]
+    return stats["native_hits"] / clusters if clusters else 0.0
+
+
+def _credit_workload(workers, n_closes=3, txs=60, **kw):
+    """Credit-asset payments over disjoint pairs + changeTrust
+    create/update salt — the credit-heavy shape real traffic has."""
+    app = _mk_app(workers, **kw)
+    lg = LoadGenerator(app)
+    lg.create_accounts(40)
+    lg.setup_credit()
+    fps = []
+    for _ in range(n_closes):
+        envs = lg.generate_credit_mix(txs, trust_pct=15)
+        assert sum(1 for e in envs
+                   if app.herder.recv_transaction(e) == 0) == len(envs)
+        _close_and_fingerprint(app, fps)
+    stats = dict(app.parallel_apply.stats)
+    hits = {name: m.count for name, m in app.metrics._metrics.items()
+            if name.startswith("apply.native.hit.")}
+    app.graceful_stop()
+    return fps, stats, hits
+
+
+def test_credit_mix_goes_native_and_matches():
+    seq, _, _ = _credit_workload(0, NATIVE_APPLY=False)
+    fps, stats, hits = _credit_workload(2)
+    _assert_identical(seq, fps, "credit mix")
+    assert stats["aborts"] == 0, stats
+    # declines on the credit mix are now bugs, not expected coverage
+    # gaps (the ISSUE-13 acceptance gate)
+    assert _hit_rate(stats) >= 0.9, stats
+    assert hits.get("apply.native.hit.payment", 0) > 0, hits
+    assert hits.get("apply.native.hit.trust", 0) > 0, hits
+
+
+def test_changetrust_delete_goes_native_and_matches():
+    """Trustline create (close 1) then delete via limit=0 (close 2) —
+    the subentry-reserve round trip, in-kernel both ways."""
+    def run(workers, native):
+        app = _mk_app(workers, NATIVE_APPLY=native)
+        lg = LoadGenerator(app)
+        lg.create_accounts(10)
+        lg.setup_credit()
+        fps = []
+        for limit in (10**9, 0):
+            envs = [lg.changetrust_envelope(sk, lg.credit2_asset, limit)
+                    for sk in lg.accounts]
+            assert sum(1 for e in envs
+                       if app.herder.recv_transaction(e) == 0) == len(envs)
+            _close_and_fingerprint(app, fps)
+        stats = dict(app.parallel_apply.stats)
+        app.graceful_stop()
+        return fps, stats
+
+    seq, _ = run(0, False)
+    fps, stats = run(2, True)
+    _assert_identical(seq, fps, "changetrust delete")
+    assert stats["native_hits"] > 0, stats
+    assert stats["native_declines"] == 0, stats
+
+
+def _pathpay_workload(workers, hops=2, n_closes=3, txs=40, **kw):
+    app = _mk_app(workers, **kw)
+    lg = LoadGenerator(app)
+    lg.create_accounts(24)
+    maker_envs = lg.setup_path(hops=hops, makers=4)
+    assert sum(1 for e in maker_envs
+               if app.herder.recv_transaction(e) == 0) == len(maker_envs)
+    fps = []
+    _close_and_fingerprint(app, fps)
+    for _ in range(n_closes):
+        envs = lg.generate_path_payments(txs)
+        assert sum(1 for e in envs
+                   if app.herder.recv_transaction(e) == 0) == len(envs)
+        _close_and_fingerprint(app, fps)
+    stats = dict(app.parallel_apply.stats)
+    hits = {name: m.count for name, m in app.metrics._metrics.items()
+            if name.startswith("apply.native.hit.")}
+    app.graceful_stop()
+    return fps, stats, hits
+
+
+def test_path_payments_go_native_and_match():
+    """2-hop strict-send + strict-receive chains over seeded books:
+    the whole close is one book-pair cluster, applied natively inline;
+    bytes identical to forced-Python."""
+    seq, _, _ = _pathpay_workload(0, NATIVE_APPLY=False)
+    fps, stats, hits = _pathpay_workload(2)
+    _assert_identical(seq, fps, "path payments")
+    assert stats["aborts"] == 0, stats
+    assert _hit_rate(stats) >= 0.9, stats
+    assert hits.get("apply.native.hit.pathpay", 0) > 0, hits
+
+
+def test_three_hop_path_payments_match():
+    seq, _, _ = _pathpay_workload(0, hops=3, n_closes=2,
+                                  NATIVE_APPLY=False)
+    fps, stats, _ = _pathpay_workload(2, hops=3, n_closes=2)
+    _assert_identical(seq, fps, "3-hop path payments")
+    assert stats["native_hits"] > 0, stats
+
+
+def test_live_pool_on_hop_declines_to_python_and_matches():
+    """A LIVE liquidity pool on a hop pair must decline the kernel
+    (pool quoting stays host-side) and the Python reference must
+    adjudicate — same bytes, decline taxonomy names the guard."""
+    from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+    from stellar_core_tpu.transactions import liquidity_pool as LP
+    from stellar_core_tpu.transactions import utils as U
+
+    def seed_pool(app, lg):
+        # a constant-product pool on the (native, PATHA) hop pair,
+        # bulk-written like the rest of the perf-rig seeding
+        a_native = U.asset_native()
+        a_credit = lg.path_assets[0]
+        a, b = ((a_native, a_credit)
+                if LP.compare_assets(a_native, a_credit) < 0
+                else (a_credit, a_native))
+        params = T.LiquidityPoolParameters.make(
+            T.LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT,
+            T.LiquidityPoolConstantProductParameters.make(
+                assetA=a, assetB=b, fee=T.LIQUIDITY_POOL_FEE_V18))
+        pool_id = LP.pool_id_from_params(params)
+        cp = T.LiquidityPoolEntry.fields[1][1].arms[
+            T.LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT][1].make(
+            params=params.value, reserveA=10**10, reserveB=10**10,
+            totalPoolShares=10**10, poolSharesTrustLineCount=1)
+        lp = T.LiquidityPoolEntry.make(
+            liquidityPoolID=pool_id,
+            body=T.LiquidityPoolEntry.fields[1][1].make(
+                T.LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT, cp))
+        with LedgerTxn(app.ledger_manager.root) as ltx:
+            ltx.put(U.wrap_entry(T.LedgerEntryType.LIQUIDITY_POOL, lp))
+            ltx.commit()
+
+    def run(workers, native):
+        app = _mk_app(workers, NATIVE_APPLY=native)
+        lg = LoadGenerator(app)
+        lg.create_accounts(12)
+        maker_envs = lg.setup_path(hops=2, makers=2)
+        assert sum(1 for e in maker_envs
+                   if app.herder.recv_transaction(e) == 0) == \
+            len(maker_envs)
+        fps = []
+        _close_and_fingerprint(app, fps)
+        seed_pool(app, lg)
+        envs = lg.generate_path_payments(20)
+        assert sum(1 for e in envs
+                   if app.herder.recv_transaction(e) == 0) == len(envs)
+        _close_and_fingerprint(app, fps)
+        stats = dict(app.parallel_apply.stats)
+        app.graceful_stop()
+        return fps, stats
+
+    seq, _ = run(0, False)
+    fps, stats = run(2, True)
+    _assert_identical(seq, fps, "pool-on-hop decline")
+    assert stats["native_declines"] > 0, stats
+    assert any("liquidity pool on hop" in r
+               for r in stats["native_decline_reasons"]), \
+        stats["native_decline_reasons"]
+
+
+def test_offer_modify_delete_go_native_and_match():
+    """offerID!=0: modify re-posts at the same id (UPDATED effect),
+    amount=0 deletes — the resting offer loads from the packed
+    snapshot, old liabilities release, the crossing loop re-runs."""
+    from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+
+    def run(workers, native):
+        app = _mk_app(workers, NATIVE_APPLY=native)
+        lg = LoadGenerator(app)
+        lg.payment_pattern = "pairs"
+        lg.create_accounts(12)
+        lg.setup_dex()
+        fps = []
+        # close 1: everyone posts a resting offer (exact-ratio amounts
+        # keep the 1% price-error threshold out of the picture)
+        envs = [lg.offer_envelope(sk, 100, 120 + i, 100)
+                for i, sk in enumerate(lg.accounts)]
+        assert sum(1 for e in envs
+                   if app.herder.recv_transaction(e) == 0) == len(envs)
+        _close_and_fingerprint(app, fps)
+        ids = {}
+        with LedgerTxn(app.ledger_manager.root) as ltx:
+            for sk in lg.accounts:
+                offers = list(ltx.offers_by_account(sk.public_key().raw))
+                assert len(offers) == 1
+                ids[sk.public_key().raw] = offers[0].data.value.offerID
+            ltx.rollback()
+        # close 2: modify every offer (new amount + price, same id)
+        envs = [lg.offer_envelope(sk, 200, 140 + i, 100,
+                                  offer_id=ids[sk.public_key().raw])
+                for i, sk in enumerate(lg.accounts)]
+        assert sum(1 for e in envs
+                   if app.herder.recv_transaction(e) == 0) == len(envs)
+        _close_and_fingerprint(app, fps)
+        # close 3: half delete (amount=0), half modify again
+        envs = []
+        for i, sk in enumerate(lg.accounts):
+            oid = ids[sk.public_key().raw]
+            if i % 2 == 0:
+                envs.append(lg.offer_envelope(sk, 0, 1, 1, offer_id=oid))
+            else:
+                envs.append(lg.offer_envelope(sk, 100, 150, 100,
+                                              offer_id=oid))
+        assert sum(1 for e in envs
+                   if app.herder.recv_transaction(e) == 0) == len(envs)
+        _close_and_fingerprint(app, fps)
+        stats = dict(app.parallel_apply.stats)
+        app.graceful_stop()
+        return fps, stats
+
+    seq, _ = run(0, False)
+    fps, stats = run(2, True)
+    _assert_identical(seq, fps, "offer modify/delete")
+    assert stats["native_hits"] > 0, stats
+    assert stats["native_declines"] == 0, stats
+    assert stats["aborts"] == 0, stats
+
+
+def test_decline_taxonomy_reaches_metrics():
+    """A decline increments apply.native.decline.<op>.<reason> so a
+    decline storm names its coverage gap in /metrics."""
+    app = _mk_app(2)
+    lg = LoadGenerator(app)
+    lg.payment_pattern = "pairs"
+    lg.create_accounts(8)
+    from stellar_core_tpu.crypto import sha256
+
+    signer_key = sha256(b"decline-taxonomy-signer")
+    op = T.Operation.make(
+        sourceAccount=None,
+        body=T.OperationBody.make(
+            T.OperationType.SET_OPTIONS,
+            T.SetOptionsOp.make(
+                inflationDest=None, clearFlags=None, setFlags=None,
+                masterWeight=None, lowThreshold=None, medThreshold=None,
+                highThreshold=None, homeDomain=None,
+                signer=T.Signer.make(
+                    key=T.SignerKey.make(
+                        T.SignerKeyType.SIGNER_KEY_TYPE_ED25519,
+                        signer_key),
+                    weight=1))))
+    assert app.herder.recv_transaction(
+        lg._sign_tx(lg.accounts[0], [op], 100)) == 0
+    app.herder.manual_close()
+    envs = lg.generate_payments(16)
+    assert sum(1 for e in envs
+               if app.herder.recv_transaction(e) == 0) == len(envs)
+    app.herder.manual_close()
+    stats = dict(app.parallel_apply.stats)
+    assert stats["native_declines"] > 0, stats
+    breakout = {name: m.count
+                for name, m in app.metrics._metrics.items()
+                if name.startswith("apply.native.decline.")}
+    assert sum(breakout.values()) == stats["native_declines"], \
+        (breakout, stats["native_declines"])
+    assert any("unsupported_account_shape" in name
+               for name in breakout), breakout
+    app.graceful_stop()
+
+
 # -- metrics / observability -------------------------------------------------
 
 def test_native_counters_reach_metrics_and_stats_line(tmp_path):

@@ -1,21 +1,32 @@
 #!/usr/bin/env python
-"""Native-apply A/B grid (ISSUE 6 acceptance): pay-heavy, mixed and
-adversarial-ring 1000-tx closes through the full node close path, over
-a native-on/off x workers 0/2/4 grid — each grid arm alternates with a
-plain-sequential close IN THE SAME SESSION so ledger-state drift (book
-growth, bucket spills) hits both arms equally.  Persists
-PARALLEL_APPLY_r10.json.
+"""Native-apply A/B grid, rev r14 (ISSUE 13 acceptance): pay-heavy,
+mixed, CREDIT-heavy and PATH-PAYMENT 1000-tx closes through the full
+node close path, over a native-on/off x workers 0/2/4 grid — each grid
+arm alternates with a plain-sequential close IN THE SAME SESSION so
+ledger-state drift (book growth, bucket spills) hits both arms
+equally.  Persists PARALLEL_APPLY_r14.json.
 
-r09 closed with the honest GIL verdict: the footprint->cluster->
-executor machinery was bit-identical but LOST wall clock (+25% pay,
-+16% mixed) because CPython time-slices the cluster workers.  This rev
-measures the closing bracket: the GIL-free native apply kernel
-(native/apply_kernel.cpp) applying kernel-eligible clusters with the
-GIL RELEASED — native-on arms should now sit BELOW their sequential
-baselines, while the native-off arms reproduce r09's overhead.
+r10 proved the kernel thesis on native-only traffic (mixed 1000-tx
+closes −50%) but the kernel declined every credit payment, trustline
+op, path payment and offer modify back to Python — while real Stellar
+traffic is credit-heavy.  This rev measures the kernel-complete strip:
+credit payments + changeTrust (shape "credit") and 2-hop path payments
+over seeded books (shape "pathpay") applied in-kernel, with the
+per-op-type hit/decline taxonomy (apply.native.hit.<op> /
+apply.native.decline.<op>.<reason>) persisted per row, and a parity
+section holding header/bucket hashes AND meta bytes bit-identical to
+the forced-Python arm across workers 0/2/4 and PYTHONHASHSEED 0/4242
+(subprocess arms).
 
-Env knobs: BENCH_CLOSES (per arm, default 8), BENCH_CLOSE_TXS
-(default 1000), BENCH_DEX_PCT (default 30).
+Env knobs: BENCH_CLOSES (per arm, default 6), BENCH_CLOSE_TXS
+(default 1000), BENCH_DEX_PCT (default 30), BENCH_PARITY_CLOSES
+(default 2).
+
+Extra modes:
+  --fingerprint SHAPE WORKERS NATIVE   print per-close fingerprints
+      (subprocess arm of the parity/hash-seed evidence)
+  --credit-smoke [--out PATH]          small credit+path parity smoke
+      with a native hit-rate gate (verify_green's credit gate)
 """
 import json
 import os
@@ -31,11 +42,8 @@ def _note(msg):
     print(f"[parallel-apply-bench] {msg}", file=sys.stderr, flush=True)
 
 
-def bench_workload(shape: str, pattern: str, n_closes: int,
-                   close_txs: int, dex_pct: int, workers: int,
-                   native: bool) -> dict:
+def _mk_app(close_txs, workers, native):
     from stellar_core_tpu.main import Application, test_config
-    from stellar_core_tpu.simulation.load_generator import LoadGenerator
     from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
 
     app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), test_config(
@@ -48,19 +56,61 @@ def bench_workload(shape: str, pattern: str, n_closes: int,
         NATIVE_APPLY_INLINE=native and workers < 2))
     app.start()
     app.herder.manual_close()  # applies the max-tx-set-size upgrade
-    lg = LoadGenerator(app)
-    lg.payment_pattern = pattern
+    return app
+
+
+def _seed_shape(app, lg, shape, close_txs):
+    """Workload seeding; pathpay needs maker offers closed for real."""
     lg.create_accounts(close_txs)
     if shape == "mixed":
         lg.setup_dex()
+    elif shape == "credit":
+        lg.setup_credit()
+    elif shape == "pathpay":
+        envs = lg.setup_path(hops=2, makers=8)
+        admitted = sum(1 for env in envs
+                       if app.herder.recv_transaction(env) == 0)
+        assert admitted == len(envs), f"maker seeding: {admitted}"
+        app.herder.manual_close()
+
+
+def _generate(lg, shape, close_txs, dex_pct):
+    if shape == "mixed":
+        return lg.generate_mixed(close_txs, dex_percent=dex_pct)
+    if shape == "credit":
+        return lg.generate_credit_mix(close_txs, trust_pct=10)
+    if shape == "pathpay":
+        return lg.generate_path_payments(close_txs)
+    return lg.generate_payments(close_txs)
+
+
+def _native_taxonomy(app) -> dict:
+    """The per-op-type hit/decline counters (executor breakout)."""
+    out = {"hit": {}, "decline": {}}
+    for name, m in sorted(app.metrics._metrics.items()):
+        if name.startswith("apply.native.hit."):
+            out["hit"][name[len("apply.native.hit."):]] = m.count
+        elif name.startswith("apply.native.decline."):
+            out["decline"][name[len("apply.native.decline."):]] = m.count
+    return out
+
+
+def bench_workload(shape: str, pattern: str, n_closes: int,
+                   close_txs: int, dex_pct: int, workers: int,
+                   native: bool) -> dict:
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+
+    app = _mk_app(close_txs, workers, native)
+    lg = LoadGenerator(app)
+    lg.payment_pattern = pattern
+    _seed_shape(app, lg, shape, close_txs)
     arms = {"sequential": [], "grid": []}
     phases = {"sequential": [], "grid": []}
     plan_rows = []
     for i in range(2 * n_closes):
         arm = "grid" if i % 2 else "sequential"
         app.parallel_apply.enabled = (arm == "grid")
-        envs = (lg.generate_mixed(close_txs, dex_percent=dex_pct)
-                if shape == "mixed" else lg.generate_payments(close_txs))
+        envs = _generate(lg, shape, close_txs, dex_pct)
         admitted = sum(1 for env in envs
                        if app.herder.recv_transaction(env) == 0)
         assert admitted == close_txs, f"only {admitted} admitted"
@@ -75,6 +125,7 @@ def bench_workload(shape: str, pattern: str, n_closes: int,
     stats["escape_reasons"] = app.parallel_apply.stats["escapes"][-4:]
     stats["decline_reasons"] = \
         app.parallel_apply.stats["native_decline_reasons"][-4:]
+    taxonomy = _native_taxonomy(app)
     app.graceful_stop()
 
     def pct(xs, q):
@@ -113,6 +164,7 @@ def bench_workload(shape: str, pattern: str, n_closes: int,
         "grid_plan_p50_ms": phase_p50("grid", "plan"),
         "native_hit_rate": (
             round(stats["native_hits"] / clusters, 4) if clusters else None),
+        "native_taxonomy": taxonomy,
         "apply_stats": stats,
     }
     if plan_rows:
@@ -137,25 +189,182 @@ def bench_workload(shape: str, pattern: str, n_closes: int,
     return row
 
 
+# -- parity (fingerprints, subprocess hash-seed arms) -------------------------
+
+def fingerprint_workload(shape: str, workers: int, native: bool,
+                         n_closes: int, close_txs: int):
+    """Per-close (ledger hash, bucket hash, sha256(meta)) fingerprints
+    of a deterministic ``shape`` workload — the parity oracle."""
+    import hashlib
+
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+    from stellar_core_tpu.xdr import types as T
+
+    app = _mk_app(close_txs, workers, native)
+    lg = LoadGenerator(app)
+    lg.payment_pattern = "pairs"
+    _seed_shape(app, lg, shape, close_txs)
+    fps = []
+
+    def close():
+        app.herder.manual_close()
+        meta = app._meta_stream[-1] if app._meta_stream else None
+        fps.append((
+            app.ledger_manager.last_closed_hash().hex(),
+            app.bucket_manager.get_bucket_list_hash().hex(),
+            hashlib.sha256(T.LedgerCloseMeta.encode(meta)).hexdigest()
+            if meta is not None else ""))
+
+    for _ in range(n_closes):
+        envs = _generate(lg, shape, close_txs, 30)
+        admitted = sum(1 for env in envs
+                       if app.herder.recv_transaction(env) == 0)
+        assert admitted == close_txs, f"only {admitted} admitted"
+        close()
+    stats = dict(app.parallel_apply.stats)
+    app.graceful_stop()
+    return fps, stats
+
+
+def _subprocess_fingerprints(shape, workers, native, n_closes, close_txs,
+                             hashseed) -> list:
+    import subprocess
+
+    env = dict(os.environ)
+    env["PYTHONHASHSEED"] = str(hashseed)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["BENCH_PARITY_CLOSES"] = str(n_closes)
+    env["BENCH_CLOSE_TXS"] = str(close_txs)
+    proc = subprocess.run(
+        [sys.executable, os.path.join("tools", "parallel_apply_bench.py"),
+         "--fingerprint", shape, str(workers), str(int(native))],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=1800)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    return [tuple(line.split()) for line in
+            proc.stdout.strip().splitlines()]
+
+
+def parity_report(shapes, n_closes, close_txs) -> dict:
+    """Native-on fingerprints across workers 0/2/4 and PYTHONHASHSEED
+    0/4242 (every arm a subprocess so the hash seed truly varies) must
+    all equal the forced-Python baseline."""
+    report = {"close_txs": close_txs, "closes": n_closes, "shapes": {}}
+    identical = True
+    for shape in shapes:
+        base = _subprocess_fingerprints(shape, 0, False, n_closes,
+                                        close_txs, 0)
+        arms = {}
+        for workers in (0, 2, 4):
+            arms[f"native_w{workers}_seed0"] = _subprocess_fingerprints(
+                shape, workers, True, n_closes, close_txs, 0)
+        arms["native_w2_seed4242"] = _subprocess_fingerprints(
+            shape, 2, True, n_closes, close_txs, 4242)
+        arms["python_w2_seed4242"] = _subprocess_fingerprints(
+            shape, 2, False, n_closes, close_txs, 4242)
+        shape_ok = all(fp == base for fp in arms.values())
+        identical = identical and shape_ok
+        report["shapes"][shape] = {
+            "identical": shape_ok,
+            "arms": sorted(arms),
+            "baseline_last_close": list(base[-1]) if base else None,
+        }
+        _note(f"parity {shape}: "
+              f"{'identical' if shape_ok else 'DIVERGED'} over "
+              f"{len(arms)} arms x {len(base)} closes")
+    report["hashes_and_meta_identical"] = identical
+    return report
+
+
+# -- the verify_green credit gate ---------------------------------------------
+
+def credit_smoke(out_path: str) -> int:
+    """Small credit+path native-vs-Python parity + hit-rate gate:
+    declines on the kernel-complete mixes are bugs now, so the smoke
+    fails under a 0.9 native cluster-hit rate (ISSUE 13 acceptance)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    n_closes = int(os.environ.get("BENCH_SMOKE_CLOSES", "2"))
+    close_txs = int(os.environ.get("BENCH_SMOKE_CLOSE_TXS", "200"))
+    report = {"metric": "native_credit_smoke", "close_txs": close_txs,
+              "closes": n_closes, "shapes": {}}
+    ok = True
+    for shape in ("credit", "pathpay"):
+        base, _ = fingerprint_workload(shape, 0, False, n_closes,
+                                       close_txs)
+        fps, stats = fingerprint_workload(shape, 2, True, n_closes,
+                                          close_txs)
+        clusters = stats["native_hits"] + stats["native_declines"] + \
+            stats["native_off"]
+        hit_rate = stats["native_hits"] / clusters if clusters else 0.0
+        row = {
+            "parity_identical": fps == base,
+            "native_hit_rate": round(hit_rate, 4),
+            "aborts": stats["aborts"],
+            "native_hits": stats["native_hits"],
+            "native_declines": stats["native_declines"],
+            "decline_reasons":
+                stats["native_decline_reasons"][-4:],
+        }
+        row["ok"] = (row["parity_identical"] and row["aborts"] == 0
+                     and hit_rate >= 0.9)
+        ok = ok and row["ok"]
+        report["shapes"][shape] = row
+        _note(f"credit-smoke {shape}: parity="
+              f"{row['parity_identical']} hit_rate={row['native_hit_rate']}"
+              f" aborts={row['aborts']} -> {'ok' if row['ok'] else 'RED'}")
+    report["ok"] = ok
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+    return 0 if ok else 1
+
+
 def main() -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    n_closes = int(os.environ.get("BENCH_CLOSES", "8"))
+
+    if "--fingerprint" in sys.argv:
+        i = sys.argv.index("--fingerprint")
+        shape, workers, native = (sys.argv[i + 1], int(sys.argv[i + 2]),
+                                  bool(int(sys.argv[i + 3])))
+        n_closes = int(os.environ.get("BENCH_PARITY_CLOSES", "2"))
+        close_txs = int(os.environ.get("BENCH_CLOSE_TXS", "1000"))
+        fps, _ = fingerprint_workload(shape, workers, native, n_closes,
+                                      close_txs)
+        for lh, bh, mh in fps:
+            print(lh, bh, mh)
+        return
+
+    if "--credit-smoke" in sys.argv:
+        out = "/tmp/_native_credit_smoke.json"
+        if "--out" in sys.argv:
+            out = sys.argv[sys.argv.index("--out") + 1]
+        sys.exit(credit_smoke(out))
+
+    n_closes = int(os.environ.get("BENCH_CLOSES", "6"))
     close_txs = int(os.environ.get("BENCH_CLOSE_TXS", "1000"))
     dex_pct = int(os.environ.get("BENCH_DEX_PCT", "30"))
+    parity_closes = int(os.environ.get("BENCH_PARITY_CLOSES", "2"))
 
-    grid = [(0, True), (2, True), (4, True), (2, False), (4, False)]
     rows = []
+    # the r10 grid rides along for trend continuity
     for shape in ("pay", "mixed"):
-        for workers, native in grid:
+        for workers, native in ((0, True), (2, True), (4, True),
+                                (2, False), (4, False)):
             rows.append(bench_workload(shape, "pairs", n_closes,
                                        close_txs, dex_pct, workers,
                                        native))
-    # the adversarial shape: one fully-connected payment ring — a
-    # single conflict cluster.  r09's planner refused it; the kernel
-    # turns it into an inline native apply of the whole strip.
+    # the ISSUE-13 grids: native on/off x workers 0/2/4, same-session
+    for shape in ("credit", "pathpay"):
+        for workers, native in ((0, True), (2, True), (4, True),
+                                (0, False), (2, False), (4, False)):
+            rows.append(bench_workload(shape, "pairs", n_closes,
+                                       close_txs, dex_pct, workers,
+                                       native))
+    # the adversarial shape: one fully-connected payment ring
     for workers, native in ((0, True), (2, True)):
         rows.append(bench_workload("pay", "ring", max(3, n_closes // 2),
                                    close_txs, dex_pct, workers, native))
+
+    parity = parity_report(("credit", "pathpay"), parity_closes,
+                           close_txs)
 
     total_aborts = sum(r["apply_stats"]["aborts"] for r in rows)
 
@@ -166,48 +375,78 @@ def main() -> None:
                 return r
         return None
 
-    headline = find("mixed", 4, True)
+    credit_on = find("credit", 4, True)
+    credit_off = find("credit", 4, False)
+    path_on = find("pathpay", 4, True)
+    path_off = find("pathpay", 4, False)
+
+    def vs(on, off, key="grid_close_p50_ms"):
+        if not (on and off and on.get(key) and off.get(key)):
+            return None
+        return round((on[key] - off[key]) / off[key] * 100.0, 1)
+
     out = {
-        "metric": "parallel_apply_native_ab_r10",
+        "metric": "parallel_apply_native_ab_r14",
         "workloads": rows,
         "aborts_total": total_aborts,
+        "parity": parity,
         "headline": {
-            "mixed_w4_native_p50_ms": headline["grid_close_p50_ms"],
-            "mixed_w4_seq_baseline_p50_ms": headline["seq_close_p50_ms"],
-            "mixed_w4_native_vs_seq_pct": headline["grid_vs_seq_pct"],
-            "native_hit_rate": headline["native_hit_rate"],
+            "credit_w4_native_p50_ms": credit_on["grid_close_p50_ms"],
+            "credit_w4_python_p50_ms": credit_off["grid_close_p50_ms"],
+            "credit_w4_native_vs_python_pct": vs(credit_on, credit_off),
+            # the apply close-phase A/B (the phase the kernel owns;
+            # verify/fee/bucket/hash/commit ride along unchanged in
+            # the whole-close number)
+            "credit_w4_apply_phase_native_vs_python_pct":
+                vs(credit_on, credit_off, "grid_apply_p50_ms"),
+            "credit_native_hit_rate": credit_on["native_hit_rate"],
+            "pathpay_w4_native_p50_ms": path_on["grid_close_p50_ms"],
+            "pathpay_w4_python_p50_ms": path_off["grid_close_p50_ms"],
+            "pathpay_w4_native_vs_python_pct": vs(path_on, path_off),
+            "pathpay_w4_apply_phase_native_vs_python_pct":
+                vs(path_on, path_off, "grid_apply_p50_ms"),
+            "pathpay_native_hit_rate": path_on["native_hit_rate"],
         },
         "honest_breakdown": {
-            "kernel": "kernel-eligible clusters (native payments, "
-                      "offerID=0 manage_sell_offer incl. crossings) "
-                      "apply inside native/apply_kernel.cpp with the "
-                      "GIL RELEASED — workers finally overlap; "
-                      "ineligible or unexpected state declines the "
-                      "cluster back to the Python reference apply "
-                      "(native_hits/declines in apply_stats).",
+            "kernel": "the kernel-complete strip (native+credit "
+                      "payments, changeTrust create/update/delete, "
+                      "manage_sell_offer create/modify/delete, path "
+                      "payments strict-send/receive over declared hop "
+                      "pairs) applies inside native/apply_kernel.cpp "
+                      "with the GIL RELEASED; unsupported shapes "
+                      "(pool-share lines, live pools on a hop, "
+                      "sponsored entries, multisig...) decline back to "
+                      "the Python reference apply, now attributed per "
+                      "op-type x reason in native_taxonomy.",
             "parity": "header/bucket hashes and meta bytes are "
                       "bit-identical native-vs-Python across workers "
-                      "0/2/4 and PYTHONHASHSEED values "
-                      "(tests/test_native_apply.py); the kernel "
-                      "round-trip-verifies every entry it parses and "
-                      "implements success paths only.",
-            "invariants": "configured invariant checkers still run on "
-                          "every Python-applied cluster; kernel-applied "
-                          "clusters rely on the kernel's own decline "
-                          "guards (exact-shape parse + bounds checks) — "
-                          "state bytes are identical either way.",
-            "native_off_arms": "the native=false columns reproduce "
-                               "r09's GIL verdict for comparison: same "
-                               "machinery, Python workers, wall-clock "
-                               "loss.",
+                      "0/2/4 and PYTHONHASHSEED 0/4242 (subprocess "
+                      "arms; the parity section above), and "
+                      "tests/test_native_apply.py holds the same "
+                      "property per op family.",
+            "conflict_shapes": "credit mixes plan disjoint "
+                               "trustline-pair clusters (workers "
+                               "spread them; batched kernel crossings "
+                               "amortize dispatch); path payments "
+                               "share their hop book-pairs so a close "
+                               "collapses into ONE cluster applied "
+                               "inline by the kernel — the win there "
+                               "is the GIL-free strip itself, not "
+                               "parallelism.",
+            "native_off_arms": "the native=false columns run the SAME "
+                               "planner/executor with Python workers — "
+                               "the r09 GIL verdict reproduced on the "
+                               "new workloads for comparison.",
         },
     }
-    path = os.path.join(REPO, "PARALLEL_APPLY_r10.json")
+    path = os.path.join(REPO, "PARALLEL_APPLY_r14.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     _note(f"persisted {path}")
     print(json.dumps({"metric": out["metric"],
                       "aborts_total": total_aborts,
+                      "parity_identical":
+                          parity["hashes_and_meta_identical"],
                       "headline": out["headline"],
                       "workloads": [
                           {k: r[k] for k in ("shape", "pattern",

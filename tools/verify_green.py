@@ -37,7 +37,12 @@ Usage: python tools/verify_green.py            -> exit 0 iff green
            --skip-soak-smoke skips the ~30 s sustained-load soak
            (tools/soak_bench.py --smoke: vitals ring populated, memory
            slope under the SLO ceiling, zero breaches, telemetry
-           disabled-cost <1% and on/off hash parity).
+           disabled-cost <1% and on/off hash parity);
+           --skip-credit-smoke skips the kernel-complete credit gate
+           (tools/parallel_apply_bench.py --credit-smoke: credit-mix +
+           path-payment closes bit-identical native-vs-Python AND
+           native cluster-hit rate >= 0.9 — declines on those mixes
+           are bugs now).
 """
 import json
 import os
@@ -242,6 +247,49 @@ def run_pipelined_smoke(cmd: str) -> "tuple":
     return problems, passed, summary
 
 
+def run_credit_native_smoke() -> "tuple":
+    """The ISSUE-13 kernel-complete gate: a small credit-mix and
+    path-payment workload must (a) close bit-identical native-vs-Python
+    and (b) hit the kernel on >= 90% of clusters — declines on the
+    kernel-complete mixes are bugs now, not expected coverage gaps.
+    Returns (problems, summary)."""
+    out = "/tmp/_t1_credit_smoke.json"
+    cmd = [sys.executable, "-m", "tools.parallel_apply_bench",
+           "--credit-smoke", "--out", out]
+    print(f"verify_green: [credit-native smoke] {' '.join(cmd)}",
+          flush=True)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=900)
+    try:
+        with open(out) as f:
+            rep = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"credit-native smoke report unreadable: {e} "
+                f"(exit {proc.returncode})"], "failed"
+    problems = []
+    for shape, row in sorted(rep.get("shapes", {}).items()):
+        if not row.get("parity_identical"):
+            problems.append(f"credit-native smoke: {shape} native/Python "
+                            "parity DIVERGED")
+        if row.get("aborts"):
+            problems.append(
+                f"credit-native smoke: {shape} {row['aborts']} aborts")
+        if row.get("native_hit_rate", 0.0) < 0.9:
+            problems.append(
+                f"credit-native smoke: {shape} hit rate "
+                f"{row.get('native_hit_rate')} < 0.9 "
+                f"(declines: {row.get('decline_reasons')})")
+    if proc.returncode != 0 and not problems:
+        problems.append(f"credit-native smoke exited {proc.returncode}")
+    summary = ", ".join(
+        f"{shape} hit_rate={row.get('native_hit_rate')} "
+        f"parity={'ok' if row.get('parity_identical') else 'FAILED'}"
+        for shape, row in sorted(rep.get("shapes", {}).items()))
+    return problems, summary or "no shapes reported"
+
+
 def run_chaos_smoke() -> "tuple":
     """One small chaos scenario end-to-end (core-4 partition+heal, with
     the same-seed determinism rerun): the full fault-inject -> heal ->
@@ -347,6 +395,7 @@ def main() -> int:
     skip_chaos = "--skip-chaos-smoke" in sys.argv
     skip_pipeline = "--skip-pipeline-smoke" in sys.argv
     skip_soak = "--skip-soak-smoke" in sys.argv
+    skip_credit = "--skip-credit-smoke" in sys.argv
     if smoke_only:
         cmd = tier1_command()
         problems, passed, summary = run_parallel_smoke(cmd)
@@ -415,6 +464,12 @@ def main() -> int:
                   flush=True)
             problems.extend(fb_problems)
             smoke_note += f", fallback smoke passed={fb_passed}"
+    if not skip_credit:
+        cr_problems, cr_summary = run_credit_native_smoke()
+        print(f"verify_green: credit-native smoke: {cr_summary}",
+              flush=True)
+        problems.extend(cr_problems)
+        smoke_note += f", credit smoke: {cr_summary}"
     if not skip_pipeline:
         pl_problems, pl_passed, pl_summary = run_pipelined_smoke(cmd)
         print(f"verify_green: pipelined-close smoke: {pl_summary}",
