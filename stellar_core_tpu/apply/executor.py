@@ -464,10 +464,11 @@ class ParallelApplyManager:
                 self.stats["native_hits"] += 1
                 metrics.counter("apply.native.hit").inc()
                 # per-op-type hit attribution (tx-granular: a cluster
-                # may mix op families)
+                # may mix op families); bounded family — past the cap
+                # new kinds collapse into apply.native.hit.other
                 for kind in sorted(res.op_kinds):
-                    metrics.counter(
-                        f"apply.native.hit.{kind}").inc(
+                    metrics.counter(metrics.bounded_name(
+                        "apply.native.hit", str(kind), cap=24)).inc(
                             res.op_kinds[kind])
                 if res.batched:
                     self.stats["batched_clusters"] += 1
@@ -477,11 +478,14 @@ class ParallelApplyManager:
                 metrics.counter("apply.native.decline").inc()
                 # reason x op-type breakout: a decline storm names its
                 # exact coverage gap in /metrics instead of hiding
-                # behind one opaque counter
-                metrics.counter(
-                    "apply.native.decline."
+                # behind one opaque counter.  Bounded family: an
+                # adversarial op mix can mint unbounded (op, reason)
+                # combinations — past the cap they collapse into
+                # apply.native.decline.other
+                metrics.counter(metrics.bounded_name(
+                    "apply.native.decline",
                     f"{res.native_op or 'cluster'}."
-                    f"{res.native_code or 'unknown'}").inc()
+                    f"{res.native_code or 'unknown'}", cap=48)).inc()
                 self.stats["native_decline_reasons"].append(
                     res.native[len("decline:"):])
                 del self.stats["native_decline_reasons"][:-32]

@@ -161,7 +161,17 @@ class HerderSCPDriver(SCPDriver):
         old = self.herder._scp_timers.pop(key, None)
         if old is not None:
             old.cancel()
-        if cb is None or timeout <= 0:
+        tl = self.herder.scp.timeline
+        arming = cb is not None and timeout > 0
+        if tl.enabled and (arming or old is not None):
+            # timer lifecycle on the slot timeline: arms and real
+            # cancels (a cancel of nothing is protocol noise)
+            fields = {"timer": "nom" if timer_id == 0 else "ballot"}
+            if arming:
+                fields["timeout"] = round(float(timeout), 3)
+            tl.record(slot_index,
+                      "timer.arm" if arming else "timer.cancel", fields)
+        if not arming:
             return
         t = VirtualTimer(self.app.clock, owner=self.app)
         t.expires_from_now(timeout)
@@ -300,14 +310,27 @@ class Herder:
         self.pending_envelopes = PendingEnvelopes(self)
         cfg = app.config
         qset = self._build_qset(cfg)
+        from ..scp.timeline import SCPTimeline
+
         self.scp = SCP(self.driver, cfg.node_id(),
                        cfg.NODE_IS_VALIDATOR, qset,
                        tally_backend=getattr(cfg, "SCP_TALLY_BACKEND",
-                                             "host"))
+                                             "host"),
+                       timeline=SCPTimeline(
+                           clock=app.clock,
+                           enabled=bool(getattr(
+                               cfg, "SCP_TIMELINE_ENABLED", True)),
+                           max_slots=int(getattr(
+                               cfg, "SCP_TIMELINE_SLOTS", 32)),
+                           per_slot=int(getattr(
+                               cfg, "SCP_TIMELINE_EVENTS_PER_SLOT", 256))))
         self.pending_envelopes.add_qset(qset)
         from .quorum_tracker import QuorumTracker
 
         self.quorum_tracker = QuorumTracker(cfg.node_id(), qset)
+        from .quorum_health import QuorumHealthMonitor
+
+        self.quorum_health = QuorumHealthMonitor(self)
         self._heard_qsets: Dict[bytes, object] = {}
         self._scp_timers: Dict = {}
         self.trigger_timer = VirtualTimer(app.clock, owner=app)
@@ -667,6 +690,11 @@ class Herder:
         """Housekeeping after a ledger actually closes locally (also called
         by the catchup manager when it drains buffered ledgers)."""
         lm = self.app.ledger_manager
+        # quorum-health evaluation first, while the closed slot's
+        # envelope state is still whole (purge below keeps only the
+        # kept slot, which is this one — but order still matters for
+        # monitors reading neighbors)
+        self.quorum_health.on_ledger_closed(slot_index)
         self.tx_queue.shift(lm.root)
         if self.app.overlay_manager is not None:
             # expire flood dedup records past their TTL (ref
@@ -684,11 +712,15 @@ class Herder:
         # unpruned map was the node's dominant RSS slope under load)
         self.pending_envelopes.prune_below(cutoff)
 
-    def check_quorum_intersection(self, qmap=None):
+    def check_quorum_intersection(self, qmap=None, max_calls=None,
+                                  max_seconds=None):
         """Run the quorum-intersection checker over the tracked network
         (ref CommandHandler 'quorum?intersection=true' +
         QuorumIntersectionChecker::create).  qmap defaults to the latest
-        slot's per-node quorum sets plus the local node."""
+        slot's per-node quorum sets plus the local node.  ``max_calls``
+        / ``max_seconds`` override the config scan budget (the
+        quorum-health monitor's periodic checks run on a much smaller
+        allowance than the synchronous admin endpoint)."""
         from .quorum_intersection import check_quorum_intersection
 
         if qmap is None:
@@ -708,9 +740,10 @@ class Herder:
         use_device = self.app.config.CRYPTO_BACKEND == "tpu"
         return check_quorum_intersection(
             qmap, use_device=use_device,
-            max_calls=self.app.config.QUORUM_INTERSECTION_MAX_CALLS,
-            max_seconds=self.app.config
-            .QUORUM_INTERSECTION_TIMEOUT_SECONDS)
+            max_calls=max_calls if max_calls is not None
+            else self.app.config.QUORUM_INTERSECTION_MAX_CALLS,
+            max_seconds=max_seconds if max_seconds is not None
+            else self.app.config.QUORUM_INTERSECTION_TIMEOUT_SECONDS)
 
     def _persist_scp_history(self, slot_index: int) -> None:
         """Persist the slot's SCP envelopes for audit + history publish

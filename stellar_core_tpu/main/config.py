@@ -295,6 +295,37 @@ class Config:
         self.SLO_MAX_CLOSE_P99_SECONDS: float = kw.get(
             "SLO_MAX_CLOSE_P99_SECONDS", 5.0)
         self.SLO_MAX_QUEUE_AGE: int = kw.get("SLO_MAX_QUEUE_AGE", 3)
+        # vitals sample taken while the local quorum slice is
+        # unsatisfiable from recently-heard nodes = a breach episode
+        # (fed by the quorum-health monitor's gauges; False disables)
+        self.SLO_QUORUM_AVAILABILITY: bool = kw.get(
+            "SLO_QUORUM_AVAILABILITY", True)
+
+        # consensus forensics (scp/timeline.py): per-slot SCP timeline
+        # ring — state-machine transitions, envelopes with verdicts,
+        # timer arms/fires — behind the scp?slot=N endpoint and the
+        # chaos engine's cross-node forensic dumps.  Recording is
+        # provably inert (telemetry on/off closes bit-identical,
+        # tests + detlint det-telemetry-readback).
+        self.SCP_TIMELINE_ENABLED: bool = kw.get(
+            "SCP_TIMELINE_ENABLED", True)
+        self.SCP_TIMELINE_SLOTS: int = kw.get("SCP_TIMELINE_SLOTS", 32)
+        self.SCP_TIMELINE_EVENTS_PER_SLOT: int = kw.get(
+            "SCP_TIMELINE_EVENTS_PER_SLOT", 256)
+
+        # quorum-health monitor (herder/quorum_health.py): one cheap
+        # qset-graph evaluation per close (heard/available/criticality
+        # gauges), plus an optional budget-capped intersection scan
+        # every PERIOD closes (0 = on demand only via the
+        # quorum-health?intersection=true endpoint)
+        self.QUORUM_HEALTH_ENABLED: bool = kw.get(
+            "QUORUM_HEALTH_ENABLED", True)
+        self.QUORUM_HEALTH_INTERSECTION_PERIOD: int = kw.get(
+            "QUORUM_HEALTH_INTERSECTION_PERIOD", 0)
+        self.QUORUM_HEALTH_INTERSECTION_MAX_CALLS: int = kw.get(
+            "QUORUM_HEALTH_INTERSECTION_MAX_CALLS", 200_000)
+        self.QUORUM_HEALTH_INTERSECTION_TIMEOUT_SECONDS: float = kw.get(
+            "QUORUM_HEALTH_INTERSECTION_TIMEOUT_SECONDS", 1.0)
 
         # invariants
         self.INVARIANT_CHECKS: List[str] = kw.get("INVARIANT_CHECKS", [])
@@ -346,6 +377,14 @@ class Config:
             raise ConfigError(
                 "TX_LIFECYCLE_RING must be >= 1 and "
                 "TX_LIFECYCLE_MAX_LIVE >= 2")
+        if self.SCP_TIMELINE_SLOTS < 1 or \
+                self.SCP_TIMELINE_EVENTS_PER_SLOT < 8:
+            raise ConfigError(
+                "SCP_TIMELINE_SLOTS must be >= 1 and "
+                "SCP_TIMELINE_EVENTS_PER_SLOT >= 8")
+        if self.QUORUM_HEALTH_INTERSECTION_PERIOD < 0:
+            raise ConfigError(
+                "QUORUM_HEALTH_INTERSECTION_PERIOD must be >= 0")
         if self.PARALLEL_APPLY_WORKERS < 0:
             raise ConfigError("PARALLEL_APPLY_WORKERS must be >= 0")
         if self.MAX_DEX_TX_OPERATIONS is not None and \

@@ -16,6 +16,20 @@ import urllib.parse
 from typing import Callable, Dict, Optional
 
 
+def _jsonable(x):
+    """Recursively hex raw bytes (node ids, consensus values) so
+    protocol-state bodies survive json.dumps — SCP internals hold
+    values as bytes, not display strings."""
+    if isinstance(x, bytes):
+        return x.hex()
+    if isinstance(x, dict):
+        return {(k.hex() if isinstance(k, bytes) else k): _jsonable(v)
+                for k, v in x.items()}
+    if isinstance(x, (list, tuple, set)):
+        return [_jsonable(v) for v in x]
+    return x
+
+
 class RawBody:
     """A non-JSON response body (Prometheus text exposition, trace JSON
     downloads): handlers return one in place of a dict and _respond
@@ -37,6 +51,7 @@ class CommandHandler:
             "metrics": self.metrics,
             "peers": self.peers,
             "quorum": self.quorum,
+            "quorum-health": self.quorum_health,
             "scp": self.scp,
             "tx": self.tx,
             "manualclose": self.manualclose,
@@ -87,6 +102,12 @@ class CommandHandler:
         # apply.native.decline.<op>.<reason>) registers on first event
         m.counter("apply.native.hit")
         m.counter("apply.native.decline")
+        # bounded per-peer overlay vitals mirrored into the registry
+        # (Prometheus rides the registry; the JSON body also carries
+        # the full structured form below)
+        om = self.app.overlay_manager
+        if om is not None:
+            om.export_peer_gauges()
         # ?format=prometheus: text exposition of the registry (plus the
         # flight recorder's span-derived timers, which live in the
         # registry as span.* Timers).  The default JSON body below is
@@ -100,6 +121,10 @@ class CommandHandler:
         snap = self.app.metrics.snapshot()
         snap["ledger.prefetch.hit-rate"] = round(
             root.prefetch_hit_rate(), 4)
+        # per-peer overlay vitals (bounded: first N peers + an "other"
+        # roll-up; overlay/manager.py peer_vitals)
+        if om is not None:
+            snap["overlay.peer.vitals"] = om.peer_vitals()
         # the close pipeline's session counters (tails, barrier wait,
         # prefetch staging) at a glance, like bucket.merge.pipeline
         snap["ledger.close.pipeline"] = {
@@ -167,12 +192,55 @@ class CommandHandler:
                 "missing_qsets": [n.hex()[:8]
                                   for n in qt.nodes_missing_qsets()]}}
 
+    def quorum_health(self, params):
+        """quorum-health[?intersection=true][&evaluate=true] — the
+        quorum-health monitor's report (herder/quorum_health.py):
+        heard/available/criticality of the local qset per close, the
+        last budget-capped intersection verdict, transitive-quorum
+        bookkeeping.  ?intersection=true runs one capped scan now;
+        ?evaluate=true forces a fresh evaluation of the current LCL."""
+        qh = self.app.herder.quorum_health
+        if params.get("evaluate") == "true":
+            qh.evaluate(self.app.ledger_manager.last_closed_seq())
+        if params.get("intersection") == "true":
+            qh.check_intersection()
+        return 200, {"quorum_health": qh.report()}
+
     def scp(self, params):
+        """scp[?slot=N][&limit=K] — per-slot consensus state PLUS the
+        forensic timeline (scp/timeline.py).  Without ?slot: the last
+        two slots' protocol state and a timeline summary.  With
+        ?slot=N: that slot's full state and every recorded timeline
+        event (nomination rounds, ballot transitions, timers, inbound
+        envelopes with verdicts) — render with
+        tools/trace_view.py --slots."""
         scp = self.app.herder.scp
+        tl = scp.timeline
+        if "slot" in params:
+            try:
+                idx = int(params["slot"])
+            except ValueError:
+                return 400, {"error": "bad slot param"}
+            slot = scp.get_slot(idx, create=False)
+            return 200, {
+                "slot": idx,
+                "state": _jsonable(slot.get_entire_state())
+                if slot is not None else None,
+                "timeline": tl.export(idx)}
         out = {}
-        for idx in sorted(scp.slots)[-2:]:
-            out[str(idx)] = scp.slots[idx].get_entire_state()
-        return 200, {"slots": out}
+        try:
+            limit = int(params.get("limit", "2"))
+        except ValueError:
+            return 400, {"error": "bad limit param"}
+        if limit <= 0:
+            # [-0:] would be the WHOLE list, the opposite of the bound
+            return 400, {"error": "bad limit param"}
+        for idx in sorted(scp.slots)[-limit:]:
+            out[str(idx)] = _jsonable(scp.slots[idx].get_entire_state())
+        return 200, {"slots": out,
+                     "timeline": {"enabled": tl.enabled,
+                                  "slots": tl.slots(),
+                                  "dropped_slots": tl.dropped_slots}}
 
     def tx(self, params):
         """Submit a transaction: tx?blob=<base64 TransactionEnvelope XDR>

@@ -91,6 +91,9 @@ class OverlayManager:
             self.peer_manager = None
             self.ban_manager = None
         self._shutting_down = False
+        # pid8s whose gauges the last export_peer_gauges wrote — so a
+        # disconnected peer's gauges can be zeroed instead of freezing
+        self._exported_peer_gauges: Set[str] = set()
         # cross-peer signature-batch admission (ROADMAP 4 companion):
         # flooded SCP envelopes accumulate here within a crank and their
         # signatures verify as ONE batch through the fixed
@@ -151,6 +154,72 @@ class OverlayManager:
     def connection_count(self) -> int:
         return len(self.authenticated)
 
+    #: individually-exported peers in /metrics; the rest aggregate
+    #: into one "other" bucket (bounded-cardinality discipline)
+    PEER_VITALS_CAP = 16
+
+    def peer_vitals(self, cap: Optional[int] = None) -> dict:
+        """Per-peer overlay vitals, bounded: the first ``cap`` peers
+        (stable id order) report individually, the remainder merge
+        into an ``other`` roll-up so a 1000-peer node exports a
+        constant-size payload."""
+        cap = self.PEER_VITALS_CAP if cap is None else cap
+        out: Dict[str, dict] = {}
+        other = {"peers": 0, "queue_depth": 0, "unique_flood_recv": 0,
+                 "duplicate_flood_recv": 0, "stale_scp_drops": 0,
+                 "bytes_read": 0, "bytes_written": 0}
+        for i, (pid, p) in enumerate(sorted(self.authenticated.items())):
+            if i < cap:
+                out[pid.hex()[:8]] = p.get_vitals()
+                continue
+            other["peers"] += 1
+            other["queue_depth"] += len(p.outbound_queue)
+            other["unique_flood_recv"] += p.unique_flood_recv
+            other["duplicate_flood_recv"] += p.duplicate_flood_recv
+            other["stale_scp_drops"] += p.stale_scp_drops
+            other["bytes_read"] += p.bytes_read
+            other["bytes_written"] += p.bytes_written
+        if other["peers"]:
+            out["other"] = other
+        return out
+
+    _PEER_GAUGE_KEYS = ("queue_depth", "unique_flood_recv",
+                        "duplicate_flood_recv", "stale_scp_drops",
+                        "bytes_read", "bytes_written")
+
+    def export_peer_gauges(self) -> None:
+        """Mirror the bounded per-peer vitals into the metrics registry
+        (Prometheus exposition rides the registry).  Membership goes
+        through ONE bounded_name family (``overlay.peer``) so all six
+        gauge families stay in lockstep and peer churn cannot grow the
+        registry past the cap: a churned-in peer past the cap folds
+        into the ``other`` roll-up (instead of overwriting it), and a
+        disconnected peer's gauges drop to zero on the next export
+        (instead of freezing at their last values forever)."""
+        m = self.app.metrics
+        named: Dict[str, dict] = {}
+        other = {k: 0.0 for k in self._PEER_GAUGE_KEYS}
+        have_other = False
+        for pid8, st in self.peer_vitals().items():
+            if pid8 != "other" and not m.bounded_name(
+                    "overlay.peer", pid8,
+                    cap=self.PEER_VITALS_CAP).endswith(".other"):
+                named[pid8] = st
+                continue
+            have_other = True
+            for k in self._PEER_GAUGE_KEYS:
+                other[k] += float(st.get(k, 0))
+        for pid8 in self._exported_peer_gauges - set(named):
+            for k in self._PEER_GAUGE_KEYS:
+                m.gauge(f"overlay.peer.{k}.{pid8}").set(0.0)
+        self._exported_peer_gauges = set(named)
+        if have_other:
+            named["other"] = other
+        for pid8, st in named.items():
+            for k in self._PEER_GAUGE_KEYS:
+                m.gauge(f"overlay.peer.{k}.{pid8}").set(
+                    float(st.get(k, 0)))
+
     def ban_peer(self, peer_id: bytes) -> None:
         self.banned_peers.add(peer_id)
         if self.ban_manager is not None:
@@ -188,14 +257,30 @@ class OverlayManager:
 
     # -- inbound dispatch (called from Peer) --------------------------------
 
+    def _note_flood(self, peer, new: bool) -> None:
+        """Per-peer + aggregate flood-dedup attribution: which peer is
+        feeding us fresh traffic vs redundant copies (the dedup hit
+        rate the flood fan-out's efficiency shows up as)."""
+        n = getattr(peer, "_last_frame_len", 0)
+        if new:
+            peer.unique_flood_recv += 1
+            peer.unique_flood_bytes += n
+            self.app.metrics.counter("overlay.flood.unique").inc()
+        else:
+            peer.duplicate_flood_recv += 1
+            peer.duplicate_flood_bytes += n
+            self.app.metrics.counter("overlay.flood.duplicate").inc()
+
     def recv_transaction(self, peer, env) -> None:
         with self.app.tracer.span("overlay.recv.transaction"):
             # lifecycle stage "recv": stamp token captured BEFORE the
             # admission work so recv->admit covers decode+validity+sigs
             recv_ts = self.app.txtracer.note_recv()
             msg = O.StellarMessage.make(O.MessageType.TRANSACTION, env)
-            if not self.floodgate.add_record(msg, peer.peer_id,
-                                             self._ledger_seq()):
+            new = self.floodgate.add_record(msg, peer.peer_id,
+                                            self._ledger_seq())
+            self._note_flood(peer, new)
+            if not new:
                 return
             res = self.app.herder.tx_queue.try_add(env, recv_ts=recv_ts)
             if res == 0:  # pending: forward
@@ -205,9 +290,17 @@ class OverlayManager:
         with self.app.tracer.span("overlay.recv.scp"):
             msg = O.StellarMessage.make(O.MessageType.SCP_MESSAGE,
                                         scp_env)
-            if not self.floodgate.add_record(msg, peer.peer_id,
-                                             self._ledger_seq()):
+            new = self.floodgate.add_record(msg, peer.peer_id,
+                                            self._ledger_seq())
+            self._note_flood(peer, new)
+            if not new:
                 return
+            # per-peer stale attribution: which peer keeps feeding
+            # out-of-bracket envelopes (the herder counts the discard
+            # itself — this names the source)
+            lo, hi = self.app.herder.scp_slot_bracket()
+            if not lo <= scp_env.statement.slotIndex <= hi:
+                peer.stale_scp_drops += 1
             if not self._sig_batching:
                 self.app.herder.recv_scp_envelope(scp_env)
                 self.broadcast_message(msg)

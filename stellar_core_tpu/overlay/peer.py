@@ -98,6 +98,20 @@ class Peer:
         self.messages_written = 0
         self.bytes_read = 0
         self.bytes_written = 0
+        # per-peer vitals (ISSUE 14): recv/sent breakdown by message
+        # type (authenticated-frame bytes), flood-dedup attribution
+        # (filled by OverlayManager on the floodgate verdict),
+        # stale-envelope drops, connect time for secondsConnected
+        self.connected_at = app.clock.now()
+        self.recv_by_type: dict = {}      # type -> [msgs, bytes]
+        self.sent_by_type: dict = {}      # type -> [msgs, bytes]
+        self.unique_flood_recv = 0
+        self.duplicate_flood_recv = 0
+        self.unique_flood_bytes = 0
+        self.duplicate_flood_bytes = 0
+        self.stale_scp_drops = 0
+        self.queue_depth_peak = 0
+        self._last_frame_len = 0
 
     # -- transport surface (subclass) ---------------------------------------
 
@@ -175,6 +189,8 @@ class Peer:
         if msg.type in FLOOD_TYPES and self.is_authenticated():
             if self.outbound_credit <= 0:
                 self.outbound_queue.append(msg)
+                if len(self.outbound_queue) > self.queue_depth_peak:
+                    self.queue_depth_peak = len(self.outbound_queue)
                 return
             self.outbound_credit -= 1
         self._send_now(msg)
@@ -191,6 +207,11 @@ class Peer:
         data = O.AuthenticatedMessage.encode(am)
         self.bytes_written += len(data)
         self.messages_written += 1
+        slot = self.sent_by_type.get(msg.type)
+        if slot is None:
+            slot = self.sent_by_type[msg.type] = [0, 0]
+        slot[0] += 1
+        slot[1] += len(data)
         self.transport_write(data)
 
     def _flush_outbound(self) -> None:
@@ -202,6 +223,7 @@ class Peer:
 
     def recv_bytes(self, data: bytes) -> None:
         self.bytes_read += len(data)
+        self._last_frame_len = len(data)
         try:
             am = O.AuthenticatedMessage.decode(data)
         except Exception:
@@ -229,6 +251,11 @@ class Peer:
         """Dispatch by type (ref Peer::recvMessage switch :781-1018)."""
         MT = O.MessageType
         t = msg.type
+        slot = self.recv_by_type.get(t)
+        if slot is None:
+            slot = self.recv_by_type[t] = [0, 0]
+        slot[0] += 1
+        slot[1] += self._last_frame_len
         if t == MT.ERROR_MSG:
             self.close(f"peer error: {msg.value.msg!r}")
             return
@@ -348,6 +375,37 @@ class Peer:
             "messages_written": self.messages_written,
             "bytes_read": self.bytes_read,
             "bytes_written": self.bytes_written,
+        }
+
+    def flood_dup_rate(self) -> float:
+        total = self.unique_flood_recv + self.duplicate_flood_recv
+        return round(self.duplicate_flood_recv / total, 4) if total else 0.0
+
+    def get_vitals(self) -> dict:
+        """Per-peer overlay vitals (ISSUE 14): queue pressure,
+        flood-dedup efficiency, stale drops, per-type traffic — the
+        /metrics `overlay.peer.vitals` body and the survey response's
+        raw material."""
+        name = O.MessageType.by_value
+        return {
+            **self.get_stats(),
+            "seconds_connected": round(
+                max(0.0, self.app.clock.now() - self.connected_at), 3),
+            "queue_depth": len(self.outbound_queue),
+            "queue_depth_peak": self.queue_depth_peak,
+            "outbound_credit": self.outbound_credit,
+            "unique_flood_recv": self.unique_flood_recv,
+            "duplicate_flood_recv": self.duplicate_flood_recv,
+            "unique_flood_bytes": self.unique_flood_bytes,
+            "duplicate_flood_bytes": self.duplicate_flood_bytes,
+            "flood_dup_rate": self.flood_dup_rate(),
+            "stale_scp_drops": self.stale_scp_drops,
+            "recv_by_type": {
+                name.get(t, str(t)): {"msgs": v[0], "bytes": v[1]}
+                for t, v in sorted(self.recv_by_type.items())},
+            "sent_by_type": {
+                name.get(t, str(t)): {"msgs": v[0], "bytes": v[1]}
+                for t, v in sorted(self.sent_by_type.items())},
         }
 
 

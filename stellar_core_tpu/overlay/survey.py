@@ -166,6 +166,20 @@ class SurveyManager:
                                for p in v.outboundPeers],
             "total_inbound": v.totalInboundPeerCount,
             "total_outbound": v.totalOutboundPeerCount,
+            # the surveyed node's per-peer vitals (ISSUE 14): flood
+            # dedup efficiency + traffic, per remote peer
+            "peers": [{
+                "id": p.id.value.hex()[:8],
+                "messages_read": p.messagesRead,
+                "messages_written": p.messagesWritten,
+                "bytes_read": p.bytesRead,
+                "bytes_written": p.bytesWritten,
+                "seconds_connected": p.secondsConnected,
+                "unique_flood_recv": p.uniqueFloodMessageRecv,
+                "duplicate_flood_recv": p.duplicateFloodMessageRecv,
+                "unique_flood_bytes": p.uniqueFloodBytesRecv,
+                "duplicate_flood_bytes": p.duplicateFloodBytesRecv,
+            } for p in v.inboundPeers],
         }
 
     # -- helpers -------------------------------------------------------------
@@ -182,9 +196,14 @@ class SurveyManager:
 
     def _topology_body(self):
         om = self.app.overlay_manager
+        now = self.app.clock.now()
         stats = []
         if om is not None:
-            for pid, p in list(om.authenticated.items())[:25]:
+            # per-peer vitals ride the survey (ISSUE 14): a surveying
+            # node collects REMOTE peers' flood-dedup and traffic
+            # stats, not just connection counts.  Sorted for a
+            # deterministic response; capped by the XDR PeerStatList.
+            for pid, p in sorted(om.authenticated.items())[:25]:
                 stats.append(O.PeerStats.make(
                     id=T.account_id(pid),
                     versionStr=p.remote_version[:100],
@@ -192,11 +211,13 @@ class SurveyManager:
                     messagesWritten=p.messages_written,
                     bytesRead=p.bytes_read,
                     bytesWritten=p.bytes_written,
-                    secondsConnected=0,
-                    uniqueFloodBytesRecv=0, duplicateFloodBytesRecv=0,
+                    secondsConnected=int(max(
+                        0.0, now - p.connected_at)),
+                    uniqueFloodBytesRecv=p.unique_flood_bytes,
+                    duplicateFloodBytesRecv=p.duplicate_flood_bytes,
                     uniqueFetchBytesRecv=0, duplicateFetchBytesRecv=0,
-                    uniqueFloodMessageRecv=0,
-                    duplicateFloodMessageRecv=0,
+                    uniqueFloodMessageRecv=p.unique_flood_recv,
+                    duplicateFloodMessageRecv=p.duplicate_flood_recv,
                     uniqueFetchMessageRecv=0,
                     duplicateFetchMessageRecv=0))
         n = len(stats)

@@ -162,6 +162,10 @@ class BallotProtocol:
 
     def ballot_timer_expired(self) -> None:
         self.timer_exp_count += 1
+        tl = self.slot.scp.timeline
+        if tl.enabled:
+            tl.record(self.slot.slot_index, "timer.fire",
+                      {"timer": "ballot", "count": self.timer_exp_count})
         self.abandon_ballot(0)
 
     def set_state_from_envelope(self, envelope) -> None:
@@ -240,6 +244,13 @@ class BallotProtocol:
         if self.current is None:
             self.driver.started_ballot_protocol(
                 self.slot.slot_index, ballot)
+        tl = self.slot.scp.timeline
+        if tl.enabled and (got_bumped or self.current is None
+                           or self.current != ballot):
+            from .timeline import value_tag
+
+            tl.record(self.slot.slot_index, "ballot.bump",
+                      {"n": ballot[0], "v": value_tag(ballot[1])})
         self.current = ballot
         # invariant: h compatible with b
         if self.high is not None and not compatible(self.current, self.high):
@@ -335,6 +346,14 @@ class BallotProtocol:
                 and self.slot.fully_validated):
             if self.last_envelope_emit is not self.last_envelope:
                 self.last_envelope_emit = self.last_envelope
+                tl = self.slot.scp.timeline
+                if tl.enabled:
+                    from .timeline import statement_fingerprint
+
+                    tl.record(self.slot.slot_index, "ballot.emit",
+                              {"fp": statement_fingerprint(
+                                  self.last_envelope_emit.statement),
+                               "phase": self.phase.name})
                 self.driver.emit_envelope(self.last_envelope_emit)
 
     # -- the whitepaper steps ---------------------------------------------
@@ -441,6 +460,12 @@ class BallotProtocol:
                 self.commit = None
                 did_work = True
         if did_work:
+            tl = self.slot.scp.timeline
+            if tl.enabled:
+                from .timeline import value_tag
+
+                tl.record(self.slot.slot_index, "ballot.accept_prepared",
+                          {"n": ballot[0], "v": value_tag(ballot[1])})
             self.driver.accepted_ballot_prepared(
                 self.slot.slot_index, ballot)
             self._emit_current_state()
@@ -522,6 +547,15 @@ class BallotProtocol:
                 self.commit = new_c
                 did_work = True
             if did_work:
+                tl = self.slot.scp.timeline
+                if tl.enabled:
+                    from .timeline import value_tag
+
+                    tl.record(self.slot.slot_index,
+                              "ballot.confirm_prepared",
+                              {"h": [new_h[0], value_tag(new_h[1])],
+                               "c": None if new_c is None else
+                               [new_c[0], value_tag(new_c[1])]})
                 self.driver.confirmed_ballot_prepared(
                     self.slot.slot_index, new_h)
         did_work = self._update_current_if_needed(new_h) or did_work
@@ -629,6 +663,14 @@ class BallotProtocol:
             did_work = True
         if did_work:
             self._update_current_if_needed(self.high)
+            tl = self.slot.scp.timeline
+            if tl.enabled:
+                from .timeline import value_tag
+
+                tl.record(self.slot.slot_index, "ballot.accept_commit",
+                          {"c": [c[0], value_tag(c[1])],
+                           "h": [h[0], value_tag(h[1])],
+                           "phase": self.phase.name})
             self.driver.accepted_commit(self.slot.slot_index, h)
             self._emit_current_state()
         return did_work
@@ -673,6 +715,13 @@ class BallotProtocol:
         self.high = h
         self._update_current_if_needed(self.high)
         self.phase = Phase.EXTERNALIZE
+        tl = self.slot.scp.timeline
+        if tl.enabled:
+            from .timeline import value_tag
+
+            tl.record(self.slot.slot_index, "ballot.externalize",
+                      {"c": [c[0], value_tag(c[1])],
+                       "h": [h[0], value_tag(h[1])]})
         self._emit_current_state()
         self.slot.stop_nomination()
         self.driver.value_externalized(self.slot.slot_index, self.commit[1])
@@ -730,6 +779,11 @@ class BallotProtocol:
             old = self.heard_from_quorum
             self.heard_from_quorum = True
             if not old:
+                tl = self.slot.scp.timeline
+                if tl.enabled:
+                    tl.record(self.slot.slot_index, "ballot.quorum",
+                              {"heard": True, "n": len(nodes),
+                               "ballot_n": self.current[0]})
                 self.driver.ballot_did_hear_from_quorum(
                     self.slot.slot_index, self.current)
                 if self.phase != Phase.EXTERNALIZE:
@@ -737,6 +791,11 @@ class BallotProtocol:
             if self.phase == Phase.EXTERNALIZE:
                 self._stop_timer()
         else:
+            if self.heard_from_quorum:
+                tl = self.slot.scp.timeline
+                if tl.enabled:
+                    tl.record(self.slot.slot_index, "ballot.quorum",
+                              {"heard": False, "n": len(nodes)})
             self.heard_from_quorum = False
             self._stop_timer()
 

@@ -162,6 +162,7 @@ class NominationProtocol:
 
         modified = False
         new_candidates = False
+        tl = self.slot.scp.timeline
 
         # votes -> accepted
         for v in nom.votes:
@@ -177,6 +178,11 @@ class NominationProtocol:
                     self.accepted.add(v)
                     self.votes.add(v)
                     modified = True
+                    if tl.enabled:
+                        from .timeline import value_tag
+
+                        tl.record(self.slot.slot_index, "nom.accept",
+                                  {"v": value_tag(v)})
                 else:
                     to_vote = self.driver.extract_valid_value(
                         self.slot.slot_index, v)
@@ -195,6 +201,11 @@ class NominationProtocol:
             ):
                 self.candidates.add(a)
                 new_candidates = True
+                if tl.enabled:
+                    from .timeline import value_tag
+
+                    tl.record(self.slot.slot_index, "nom.candidate",
+                              {"v": value_tag(a)})
                 # whitepaper: stop nominating new values once a candidate
                 # exists
                 self.driver.setup_timer(
@@ -206,6 +217,11 @@ class NominationProtocol:
             if new_vote is not None:
                 self.votes.add(new_vote)
                 modified = True
+                if tl.enabled:
+                    from .timeline import value_tag
+
+                    tl.record(self.slot.slot_index, "nom.vote",
+                              {"v": value_tag(new_vote), "echo": True})
                 self.driver.nominating_value(
                     self.slot.slot_index, new_vote)
 
@@ -217,6 +233,12 @@ class NominationProtocol:
                 self.slot.slot_index, set(self.candidates))
             if composite is not None:
                 self.latest_composite = composite
+                if tl.enabled:
+                    from .timeline import value_tag
+
+                    tl.record(self.slot.slot_index, "nom.composite",
+                              {"v": value_tag(composite),
+                               "candidates": len(self.candidates)})
                 self.driver.updated_candidate_value(
                     self.slot.slot_index, composite)
                 self.slot.bump_state(composite, False)
@@ -246,6 +268,13 @@ class NominationProtocol:
         self.previous_value = previous_value
         self.round_number += 1
         self._update_round_leaders()
+        tl = self.slot.scp.timeline
+        if tl.enabled:
+            tl.record(self.slot.slot_index, "nom.round",
+                      {"round": self.round_number, "timedout": timedout,
+                       "leaders": len(self.round_leaders),
+                       "self_leader": self.local_node.node_id
+                       in self.round_leaders})
 
         updated = False
         # add a few more values from the leaders' nominations.  Sorted:
@@ -261,12 +290,23 @@ class NominationProtocol:
                 if v is not None:
                     self.votes.add(v)
                     updated = True
+                    if tl.enabled:
+                        from .timeline import value_tag
+
+                        tl.record(self.slot.slot_index, "nom.vote",
+                                  {"v": value_tag(v),
+                                   "leader": leader.hex()[:8]})
                     self.driver.nominating_value(self.slot.slot_index, v)
         # if we're a leader, seed our own value
         if self.local_node.node_id in self.round_leaders and not self.votes:
             if value not in self.votes:
                 self.votes.add(value)
                 updated = True
+                if tl.enabled:
+                    from .timeline import value_tag
+
+                    tl.record(self.slot.slot_index, "nom.vote",
+                              {"v": value_tag(value), "own": True})
                 self.driver.nominating_value(self.slot.slot_index, value)
 
         timeout = self.driver.compute_timeout(self.round_number, True)
@@ -306,6 +346,11 @@ class NominationProtocol:
                     self.last_envelope = env
                     if self.slot.fully_validated:
                         self.last_envelope_emit = env
+                        tl = self.slot.scp.timeline
+                        if tl.enabled:
+                            tl.record(self.slot.slot_index, "nom.emit",
+                                      {"votes": len(self.votes),
+                                       "accepted": len(self.accepted)})
                         self.driver.emit_envelope(env)
             else:
                 raise RuntimeError(

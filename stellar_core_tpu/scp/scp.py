@@ -8,11 +8,13 @@ from typing import Dict, List, Optional
 from .driver import SCPDriver
 from .local_node import LocalNode
 from .slot import EnvelopeState, Slot
+from .timeline import SCPTimeline
 
 
 class SCP:
     def __init__(self, driver: SCPDriver, node_id: bytes, is_validator: bool,
-                 qset, tally_backend: str = "host"):
+                 qset, tally_backend: str = "host",
+                 timeline: Optional[SCPTimeline] = None):
         self.driver = driver
         self.local_node = LocalNode(node_id, qset, is_validator)
         self.slots: Dict[int, Slot] = {}
@@ -20,6 +22,11 @@ class SCP:
         # batched device kernels (ops/quorum.py), optionally with the host
         # oracle asserting equality (see scp/tally.py)
         self.tally_backend = tally_backend
+        # per-slot forensic timeline (scp/timeline.py): disabled inert
+        # recorder unless the host installs an enabled one.  The ring is
+        # deliberately independent of purge_slots — forensics outlives
+        # the protocol state it describes.
+        self.timeline = timeline if timeline is not None else SCPTimeline()
 
     # -- slots -------------------------------------------------------------
 

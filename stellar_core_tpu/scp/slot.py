@@ -72,10 +72,26 @@ class Slot:
         st = envelope.statement
         if st.slotIndex != self.slot_index:
             raise ValueError("envelope for wrong slot")
+        tl = self.scp.timeline
+        if tl.enabled:
+            # recorded BEFORE processing so the envelope precedes the
+            # transitions it causes; the verdict is appended below.
+            # Rejected envelopes are recorded too — a refused
+            # equivocating twin is forensic witness material.
+            from .timeline import statement_fingerprint, summarize_statement
+
+            ev = {"from": node_of(st).hex()[:8],
+                  "st": summarize_statement(st),
+                  "fp": statement_fingerprint(st)}
+            if self_:
+                ev["self"] = True
+            tl.record(self.slot_index, "env", ev)
         if pledge_type(st) == T.SCPStatementType.SCP_ST_NOMINATE:
             res = self.nomination.process_envelope(envelope)
         else:
             res = self.ballot.process_envelope(envelope, self_)
+        if tl.enabled:
+            ev["ok"] = res == EnvelopeState.VALID
         if res == EnvelopeState.VALID:
             self.statements_history.append(st)
         return res

@@ -42,6 +42,8 @@ faults cleared (``time_to_heal``).
 """
 from __future__ import annotations
 
+import json
+import os
 import random
 import time as _wall
 from typing import Callable, Dict, List, Optional, Tuple
@@ -425,6 +427,162 @@ class ChaosEngine:
 
 
 # ---------------------------------------------------------------------------
+# network-wide forensic aggregator (ISSUE 14 tentpole part 4)
+# ---------------------------------------------------------------------------
+
+def first_hash_divergence(chaos: ChaosEngine) -> Optional[dict]:
+    """First slot where two honest nodes externalized different header
+    hashes — the fork's ground zero (None while no divergence)."""
+    honest = sorted(chaos.honest_alive())
+    seqs = sorted({s for n in honest
+                   for s in chaos.extern_hashes.get(n, {})})
+    for s in seqs:
+        by_hash: Dict[str, List[str]] = {}
+        for n in honest:
+            h = chaos.extern_hashes.get(n, {}).get(s)
+            if h is not None:
+                by_hash.setdefault(h.hex()[:16], []).append(n.hex()[:8])
+        if len(by_hash) > 1:
+            return {"slot": s, "nodes": dict(sorted(by_hash.items()))}
+    return None
+
+
+def collect_forensics(sim: Simulation, chaos: ChaosEngine, label: str,
+                      seed: int, reason: str) -> dict:
+    """Merge every alive node's SCP timeline into one cross-node
+    forensic record with first-divergence attribution: which node,
+    which slot, which message.
+
+    Attribution order: equivocation evidence (two mutually-unordered
+    statements from one node for one slot, found by
+    scp/timeline.find_equivocations over the merged exports) beats the
+    raw externalized-hash divergence — the hash split is the SYMPTOM,
+    the conflicting statement pair is the CAUSE and names its emitter.
+    Everything here is a pure function of sim state and virtual time,
+    so a same-seed rerun reproduces the dump byte-for-byte."""
+    from ..scp.timeline import find_equivocations
+
+    timelines = {}
+    for nid in sorted(sim.alive_nodes()):
+        app = sim.nodes[nid]
+        timelines[nid.hex()[:8]] = app.herder.scp.timeline.export()
+    extern = {
+        nid.hex()[:8]: {str(s): h.hex()
+                        for s, h in sorted(
+                            chaos.extern_hashes.get(nid, {}).items())}
+        for nid in sorted(chaos.extern_hashes)}
+    divergence = first_hash_divergence(chaos)
+    equivocations = find_equivocations(timelines)
+    first: Optional[dict] = None
+    if equivocations:
+        e = equivocations[0]  # already sorted by (slot, node)
+        first = {"via": "equivocation", "slot": e["slot"],
+                 "node": e["node"],
+                 "message": {"proto": e["proto"],
+                             "statements": e["statements"]}}
+    elif divergence is not None:
+        first = {"via": "externalized-hash",
+                 "slot": divergence["slot"],
+                 "node": divergence["nodes"], "message": None}
+    return {
+        "forensics_schema": 1,
+        "scenario": label,
+        "seed": seed,
+        "reason": reason,
+        "nodes": {
+            "honest": sorted(n.hex()[:8] for n in chaos.honest_alive()),
+            "byzantine": sorted(n.hex()[:8] for n in chaos.byzantine),
+            "crashed": sorted(n.hex()[:8] for n, dead
+                              in sim.crashed.items() if dead)},
+        "first_divergence": first,
+        "divergence": divergence,
+        "equivocations": equivocations,
+        "per_node_externalized": extern,
+        "chaos_events": [list(e) for e in chaos.events],
+        "timelines": timelines,
+    }
+
+
+def dump_forensics(report: dict, out_dir: Optional[str] = None) -> str:
+    """Persist one forensic record as FORENSICS_<scenario>_seed<N>.json
+    (sorted keys, trailing newline — byte-identical across same-seed
+    reruns)."""
+    out_dir = out_dir or os.getcwd()
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(
+        out_dir, f"FORENSICS_{report['scenario']}_seed"
+                 f"{report['seed']}.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def run_induced_fork(make_sim: Callable[[], Simulation], seed: int,
+                     duration: float = 60.0,
+                     forensics_dir: Optional[str] = None) -> tuple:
+    """Deliberately fork a deliberately-unsafe network and prove the
+    forensics name the culprit: one equivocating Byzantine node on a
+    sub-intersecting quorum (e.g. core-4 at threshold 2, where
+    {victim, byzantine} is a full quorum) splits honest nodes onto
+    conflicting values.  EXPECTS the fork: raises if none happens
+    within ``duration`` virtual seconds; otherwise dumps the merged
+    forensic record and returns (report, dump_path).
+
+    This is the verify_green forensic smoke's engine: the dump's
+    first_divergence must identify the equivocator and the forked
+    slot, and a same-seed rerun must reproduce the dump bytes."""
+    sim = make_sim()
+    chaos = ChaosEngine(sim, seed)
+    sim.start_all_nodes()
+    while sim.crank():
+        pass
+    chaos.start_maintenance()
+    rng = random.Random(int.from_bytes(
+        sha256(b"induced-fork-%d" % seed), "big"))
+    ids = sorted(sim.nodes)
+    byz = rng.choice(ids)
+    honest = [n for n in ids if n != byz]
+    # the full Byzantine bridge: the node equivocates to its peers,
+    # relays NOTHING across them (selective forwarding), and the
+    # honest nodes are partitioned around it — each side can only
+    # reach quorum WITH the bridge, on the bridge's conflicting values
+    chaos.equivocate(byz)
+    sim.nodes[byz].overlay_manager.broadcast_message = \
+        lambda msg, force=False: None
+    chaos.partition([[honest[0]], honest[1:]])
+    clock = sim.clock
+    t_end = clock.now() + duration
+    div = None
+    seen_externs = -1
+    while clock.now() < t_end:
+        if clock.crank(block=True) == 0 and \
+                clock.next_deadline() is None:
+            break
+        n_ext = sum(len(v) for v in chaos.extern_hashes.values())
+        if n_ext != seen_externs:
+            seen_externs = n_ext
+            div = first_hash_divergence(chaos)
+            if div is not None:
+                break
+    chaos.stop()
+    try:
+        if div is None:
+            raise AssertionError(
+                f"induced-fork seed {seed}: no honest divergence within "
+                f"{duration}s virtual — the unsafe quorum never split")
+        rep = collect_forensics(
+            sim, chaos, "induced_fork", seed,
+            reason=f"scripted fork probe: header divergence at slot "
+                   f"{div['slot']}")
+        path = dump_forensics(rep, forensics_dir)
+    finally:
+        for nid in list(sim.alive_nodes()):
+            sim.nodes[nid].stop_node()
+    return rep, path
+
+
+# ---------------------------------------------------------------------------
 # scenario runner
 # ---------------------------------------------------------------------------
 
@@ -445,7 +603,8 @@ def run_scenario(make_sim: Callable[[], Simulation], seed: int,
                  events: List[Tuple[float, str,
                                     Callable[[ChaosEngine], None]]],
                  duration: float, label: str,
-                 converge_timeout: float = 120.0) -> dict:
+                 converge_timeout: float = 120.0,
+                 forensics_dir: Optional[str] = None) -> dict:
     """Run one scripted chaos scenario end to end and return its report.
 
     ``events`` is a list of (virtual-time offset, label, fn(chaos));
@@ -457,6 +616,13 @@ def run_scenario(make_sim: Callable[[], Simulation], seed: int,
     ``converge_timeout`` virtual seconds.  An invariant violation or a
     crash anywhere in a close raises out of the crank and fails the
     scenario — those are P0s, not statistics.
+
+    When any oracle FAILS (fork, convergence/heal timeout, unfired
+    script), the runner dumps the merged cross-node slot timeline with
+    first-divergence attribution to ``FORENSICS_*.json`` under
+    ``forensics_dir`` (cwd by default) and re-raises with the path —
+    a failing schedule becomes a readable timeline, not a
+    rerun-and-guess.
     """
     sim = make_sim()
     chaos = ChaosEngine(sim, seed)
@@ -479,16 +645,30 @@ def run_scenario(make_sim: Callable[[], Simulation], seed: int,
                 clock.next_deadline() is None:
             break
 
+    def _oracle_failed(err: AssertionError) -> None:
+        """Any failed oracle dumps the merged forensic timeline and
+        re-raises with the artifact path attached."""
+        chaos.stop()
+        try:
+            path = dump_forensics(
+                collect_forensics(sim, chaos, label, seed,
+                                  reason=str(err)), forensics_dir)
+        finally:
+            for nid in list(sim.alive_nodes()):
+                sim.nodes[nid].stop_node()
+        raise AssertionError(f"{err}\n[forensics] {path}") from None
+
     # every scripted event must have fired inside the fault window — a
     # scenario whose script outlives its duration silently tests
     # nothing (the tiered stale_replay caught this: its replay timer
     # was cancelled before firing and the run reported a clean pass)
     fired = sum(1 for _, what in chaos.events
                 if what.startswith("event: "))
-    assert fired == len(events), (
-        f"[{label}] only {fired}/{len(events)} scripted events fired "
-        f"within duration {duration}s — extend the duration to cover "
-        f"the script")
+    if fired != len(events):
+        _oracle_failed(AssertionError(
+            f"[{label}] only {fired}/{len(events)} scripted events fired "
+            f"within duration {duration}s — extend the duration to cover "
+            f"the script"))
 
     # clear every remaining fault and start the heal stopwatch
     for nid in [n for n, dead in sim.crashed.items() if dead]:
@@ -515,10 +695,11 @@ def run_scenario(make_sim: Callable[[], Simulation], seed: int,
         if clock.crank(block=True) == 0 and \
                 clock.next_deadline() is None:
             break
-    assert converged(), (
-        f"[{label}] honest survivors failed to converge on seq {target} "
-        f"within {converge_timeout}s virtual: "
-        f"{[(n.hex()[:8], sim.nodes[n].ledger_manager.last_closed_seq()) for n in honest]}")
+    if not converged():
+        _oracle_failed(AssertionError(
+            f"[{label}] honest survivors failed to converge on seq "
+            f"{target} within {converge_timeout}s virtual: "
+            f"{[(n.hex()[:8], sim.nodes[n].ledger_manager.last_closed_seq()) for n in honest]}"))
     # healed when the LAST honest node externalized the target seq
     time_to_heal = round(
         max(0.0, max(
@@ -527,7 +708,10 @@ def run_scenario(make_sim: Callable[[], Simulation], seed: int,
     chaos.stop()
 
     # safety: full header-chain + bucket-hash agreement, all honest pairs
-    fork_comparisons = sim.assert_no_forks(honest)
+    try:
+        fork_comparisons = sim.assert_no_forks(honest)
+    except AssertionError as e:
+        _oracle_failed(e)
 
     # close-latency statistics over the whole run
     spread_ms: List[float] = []
@@ -564,6 +748,15 @@ def run_scenario(make_sim: Callable[[], Simulation], seed: int,
         "fork_comparisons": fork_comparisons,
         "fingerprint": chaos.fingerprint(),
         "events": chaos.events,
+        # raw per-node externalize record (hash prefixes): the
+        # rerun-mismatch oracle's forensic material — chaos_bench
+        # diffs two runs' maps to name the first (node, seq) that
+        # diverged between reruns
+        "per_node_externalized": {
+            nid.hex()[:8]: {str(s): h.hex()[:16]
+                            for s, h in sorted(
+                                chaos.extern_hashes[nid].items())}
+            for nid in sorted(chaos.extern_hashes)},
     }
     # release node resources (DB handles, pools) without stopping the
     # clock mid-assert; the sim object dies with this frame
@@ -648,7 +841,8 @@ STANDARD_SCENARIOS = ("partition_heal", "crash_restore", "laggard",
 def run_standard_scenario(make_sim: Callable[[], Simulation],
                           scenario: str, seed: int, n_nodes: int,
                           duration: float = 20.0,
-                          converge_timeout: float = 120.0) -> dict:
+                          converge_timeout: float = 120.0,
+                          forensics_dir: Optional[str] = None) -> dict:
     """Resolve a named scenario against the canned topologies' node
     order (node ids are a pure function of the node index, so no sim
     needs building to know them) and run it.  The victim-choosing RNG
@@ -665,4 +859,5 @@ def run_standard_scenario(make_sim: Callable[[], Simulation],
     # stale_replay's t=16 injection never fire on short-duration tiers
     duration = max(duration, max(t for t, _, _ in events) + 2.0)
     return run_scenario(make_sim, seed, events, duration, scenario,
-                        converge_timeout=converge_timeout)
+                        converge_timeout=converge_timeout,
+                        forensics_dir=forensics_dir)

@@ -168,6 +168,9 @@ class MetricsRegistry:
 
         self._clock = clock
         self._metrics: Dict[str, object] = {}
+        # bounded-cardinality metric families (bounded_name): family ->
+        # admitted member suffixes.  Guarded by _reg_lock.
+        self._families: Dict[str, set] = {}
         # registration is the one cross-thread mutation (the pipelined
         # close's tail worker and gc callbacks both register lazily):
         # without the lock, two threads racing the get-then-insert
@@ -202,6 +205,28 @@ class MetricsRegistry:
     def gauge(self, name: str) -> Gauge:
         return self._get(name, Gauge)
 
+    def bounded_name(self, family: str, member: str,
+                     cap: int = 32) -> str:
+        """Bounded-cardinality guard for label-shaped metric families
+        (``apply.native.decline.<op>.<why>``, ``overlay.peer.*.<id>``):
+        the first ``cap`` DISTINCT members keep their own metric name
+        (``family.member``); every later member collapses into
+        ``family.other``, so an adversarial label mix (hostile op
+        shapes, peer churn) cannot grow the registry — and the
+        /metrics payload — without bound.  Admission is deterministic
+        first-come.  Member strings are sanitized (dots allowed, other
+        separators collapse) so a hostile slug cannot fork families."""
+        member = member.replace("\n", "_").replace(" ", "_") or "unknown"
+        members = self._families.get(family)
+        if members is not None and member in members:
+            return f"{family}.{member}"
+        with self._reg_lock:
+            members = self._families.setdefault(family, set())
+            if member in members or len(members) < cap:
+                members.add(member)
+                return f"{family}.{member}"
+        return f"{family}.other"
+
     def snapshot(self) -> dict:
         out = {}
         for name, m in sorted(self._metrics.items()):
@@ -222,6 +247,7 @@ class MetricsRegistry:
     def reset(self) -> None:
         """MetricResetter equivalent for tests."""
         self._metrics.clear()
+        self._families.clear()
 
 
 # -- Prometheus exposition ---------------------------------------------------

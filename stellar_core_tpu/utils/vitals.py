@@ -278,6 +278,34 @@ class VitalsSampler:
                 "queue-age",
                 f"tx queue age {sample['tx_queue_age_max']} ledgers > "
                 f"{age_cap}"))
+        if getattr(cfg, "SLO_QUORUM_AVAILABILITY", True):
+            # fed by the quorum-health monitor (herder/quorum_health.py):
+            # a sample taken while the local quorum slice is
+            # unsatisfiable from recently-heard nodes is a breach —
+            # only once the monitor has actually evaluated
+            qh = getattr(getattr(self.app, "herder", None),
+                         "quorum_health", None)
+            if qh is not None and qh.enabled and qh.evaluations > 0 \
+                    and not getattr(cfg, "MANUAL_CLOSE", False):
+                # per-close evaluation freezes during a total stall —
+                # the primary failure this SLO exists to catch — so
+                # once closes are overdue, re-evaluate against the
+                # LIVE slot, where the silence actually is
+                stale_after = max(
+                    4 * getattr(cfg, "EXP_LEDGER_TIMESPAN_SECONDS", 5.0),
+                    2 * self.period)
+                if self.app.clock.now() - qh.last_eval_time > stale_after:
+                    qh.evaluate(
+                        self.app.ledger_manager.last_closed_seq() + 1)
+            mm = self.app.metrics._metrics
+            avail = mm.get("quorum.health.available")
+            evals = mm.get("quorum.health.evaluations")
+            if avail is not None and evals is not None and \
+                    evals.count > 0 and avail.value < 1.0:
+                breaches.append((
+                    "quorum-availability",
+                    "local quorum slice unsatisfiable from "
+                    "recently-heard nodes"))
         breached_now = set()
         for name, msg in breaches:
             breached_now.add(name)

@@ -1,0 +1,481 @@
+"""Consensus forensics (ISSUE 14): the per-slot SCP timeline recorder
+(scp/timeline.py), the quorum-health monitor (herder/quorum_health.py),
+bounded-cardinality metric families (MetricsRegistry.bounded_name),
+and the chaos engine's network-wide fork forensics.
+
+The load-bearing contracts:
+
+* the recorder is INERT — telemetry-on and telemetry-off closes are
+  bit-identical (ledger hash, bucket hash, encoded meta bytes);
+* cross-node timeline merges detect equivocation (two mutually
+  unordered statements from one node for one slot) and the induced
+  fork's FORENSICS_*.json names the Byzantine node and the forked
+  slot, byte-identically across same-seed reruns;
+* adversarial label mixes (hostile op shapes, peer churn) cannot grow
+  the /metrics payload without bound.
+"""
+import hashlib
+import json
+
+import pytest
+
+from stellar_core_tpu.main import Application, test_config
+from stellar_core_tpu.main.http_server import CommandHandler
+from stellar_core_tpu.scp.timeline import (
+    SCPTimeline, find_equivocations, is_newer_summary,
+    summaries_equivocate, value_tag,
+)
+from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+from stellar_core_tpu.utils.metrics import MetricsRegistry, render_prometheus
+from stellar_core_tpu.xdr import types as T
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def now(self) -> float:
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# timeline ring
+# ---------------------------------------------------------------------------
+
+def test_disabled_timeline_records_nothing():
+    tl = SCPTimeline()  # bare recorder: disabled, inert
+    assert not tl.enabled
+    tl.record(1, "env", {"from": "aa"})
+    assert tl.slots() == []
+    assert tl.export()["slots"] == {}
+
+
+def test_per_slot_ring_drops_oldest_and_counts():
+    tl = SCPTimeline(clock=FakeClock(), enabled=True, per_slot=8)
+    for i in range(11):
+        tl.record(5, "env", {"i": i})
+    doc = tl.export(5)
+    assert doc["recorded"] and doc["dropped"] == 3
+    assert [e["i"] for e in doc["events"]] == list(range(3, 11))
+
+
+def test_slot_ring_evicts_oldest_slot():
+    tl = SCPTimeline(clock=FakeClock(), enabled=True, max_slots=3)
+    for s in (1, 2, 3, 4, 5):
+        tl.record(s, "nom.round", {"round": 1})
+    assert tl.slots() == [3, 4, 5]
+    assert tl.dropped_slots == 2
+    assert tl.export(1)["recorded"] is False
+
+
+def test_event_carries_clock_time():
+    clk = FakeClock()
+    tl = SCPTimeline(clock=clk, enabled=True)
+    clk.t = 1.25
+    tl.record(7, "timer.arm", {"timer": "nom"})
+    assert tl.export(7)["events"][0]["t"] == 1.25
+
+
+# ---------------------------------------------------------------------------
+# statement summaries: order + equivocation detection
+# ---------------------------------------------------------------------------
+
+def _nom(votes, accepted=()):
+    return {"type": "NOMINATE", "votes": list(votes),
+            "accepted": list(accepted)}
+
+
+def _prep(b, p=None, pp=None, nC=0, nH=0):
+    return {"type": "PREPARE", "b": b, "p": p, "pp": pp,
+            "nC": nC, "nH": nH}
+
+
+def test_nomination_summary_order():
+    older, newer = _nom(["aa"]), _nom(["aa", "bb"], ["aa"])
+    assert is_newer_summary(older, newer) is True
+    assert is_newer_summary(newer, older) is False
+    assert is_newer_summary(older, older) is False  # equal: not newer
+    assert not summaries_equivocate(older, newer)
+
+
+def test_ballot_phase_rank_orders_summaries():
+    prep = _prep([1, "aa"])
+    conf = {"type": "CONFIRM", "b": [1, "aa"], "nP": 1, "nC": 1, "nH": 1}
+    ext = {"type": "EXTERNALIZE", "c": [1, "aa"], "nH": 1}
+    assert is_newer_summary(prep, conf) is True
+    assert is_newer_summary(conf, ext) is True
+    assert is_newer_summary(ext, conf) is False
+    assert not summaries_equivocate(prep, conf)
+
+
+def test_disjoint_nominations_equivocate():
+    a, b = _nom(["aa"]), _nom(["bb"])
+    assert is_newer_summary(a, b) is False
+    assert is_newer_summary(b, a) is False
+    assert summaries_equivocate(a, b)
+
+
+def test_cross_protocol_pairs_never_equivocate():
+    assert is_newer_summary(_nom(["aa"]), _prep([1, "aa"])) is None
+    assert not summaries_equivocate(_nom(["aa"]), _prep([1, "aa"]))
+
+
+def _export(events_by_slot):
+    return {"slots": {str(s): {"dropped": 0, "events": evs}
+                      for s, evs in events_by_slot.items()}}
+
+
+def test_find_equivocations_names_emitter_and_witnesses():
+    twin_a = {"kind": "env", "t": 1.0, "from": "badc0ffe",
+              "st": _nom(["aa"]), "fp": "f1"}
+    twin_b = {"kind": "env", "t": 1.1, "from": "badc0ffe",
+              "st": _nom(["bb"]), "fp": "f2"}
+    # each honest half saw a different twin; one witness saw both
+    out = find_equivocations({
+        "n1": _export({4: [twin_a]}),
+        "n2": _export({4: [twin_b]}),
+        "n3": _export({4: [twin_a, twin_b]}),
+    })
+    assert len(out) == 1
+    e = out[0]
+    assert (e["slot"], e["node"], e["proto"]) == (4, "badc0ffe", "nom")
+    assert e["conflicting_pairs"] == 1
+    wit = {w for s in e["statements"] for w in s["witnesses"]}
+    assert wit == {"n1", "n2", "n3"}
+
+
+def test_find_equivocations_ignores_honest_progressions():
+    older = {"kind": "env", "t": 1.0, "from": "cafe0001",
+             "st": _nom(["aa"]), "fp": "f1"}
+    newer = {"kind": "env", "t": 1.5, "from": "cafe0001",
+             "st": _nom(["aa", "bb"], ["aa"]), "fp": "f2"}
+    assert find_equivocations({"n1": _export({4: [older, newer]})}) == []
+
+
+def test_value_tag_is_order_preserving_prefix():
+    assert value_tag(None) is None
+    v = bytes(range(64))
+    assert value_tag(v) == v[:40].hex()
+
+
+# ---------------------------------------------------------------------------
+# inertness: telemetry-on vs telemetry-off closes are bit-identical
+# ---------------------------------------------------------------------------
+
+def _close_fingerprints(**cfg_kw):
+    """(ledger hash, bucket hash, encoded meta) per close over a real
+    payment workload, plus the recorder's event count at the end."""
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME),
+                     test_config(**cfg_kw))
+    app.start()
+    handler = CommandHandler(app)
+    prints = []
+
+    def close():
+        app.herder.manual_close()
+        meta = app._meta_stream[-1] if app._meta_stream else None
+        prints.append((
+            app.ledger_manager.last_closed_hash(),
+            app.bucket_manager.get_bucket_list_hash(),
+            T.LedgerCloseMeta.encode(meta) if meta is not None else b""))
+
+    code, body = handler.handle("generateload",
+                                {"mode": "create", "accounts": "20"})
+    assert code == 200, body
+    close()
+    for _ in range(3):
+        code, body = handler.handle("generateload",
+                                    {"mode": "pay", "txs": "30"})
+        assert code == 200, body
+        close()
+    tl = app.herder.scp.timeline
+    events = sum(len(b.events) for b in tl._slots.values())
+    app.graceful_stop()
+    return prints, events
+
+
+def test_recorder_on_off_closes_bit_identical():
+    on, on_events = _close_fingerprints(SCP_TIMELINE_ENABLED=True)
+    off, off_events = _close_fingerprints(SCP_TIMELINE_ENABLED=False)
+    assert on_events > 0, "enabled recorder captured nothing"
+    assert off_events == 0, "disabled recorder captured events"
+    assert len(on) == len(off) >= 4
+    for i, (a, b) in enumerate(zip(on, off)):
+        assert a[0] == b[0], f"ledger hash diverged at close {i}"
+        assert a[1] == b[1], f"bucket hash diverged at close {i}"
+        assert a[2] == b[2], f"meta bytes diverged at close {i}"
+    assert any(len(m) > 200 for _, _, m in on)
+
+
+# ---------------------------------------------------------------------------
+# the scp / quorum-health endpoints
+# ---------------------------------------------------------------------------
+
+def _closed_node():
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), test_config())
+    app.start()
+    handler = CommandHandler(app)
+    code, _ = handler.handle("generateload",
+                             {"mode": "create", "accounts": "10"})
+    assert code == 200
+    app.herder.manual_close()
+    app.herder.manual_close()
+    return app, handler
+
+
+def test_scp_endpoint_serves_slot_timeline():
+    app, handler = _closed_node()
+    try:
+        tl = app.herder.scp.timeline
+        assert tl.slots(), "no recorded slots after two closes"
+        code, body = handler.handle("scp", {"slot": str(tl.slots()[-1])})
+        assert code == 200
+        evs = body["timeline"]["events"]
+        kinds = {e["kind"] for e in evs}
+        # the full consensus story of one slot: nomination, ballot,
+        # timers, inbound envelopes with verdicts
+        assert {"nom.round", "ballot.bump", "env"} <= kinds
+        assert {"ballot.externalize"} <= kinds
+        assert all(e["ok"] for e in evs if e["kind"] == "env")
+        code, body = handler.handle("scp", {})
+        assert code == 200 and body["timeline"]["enabled"]
+        assert body["timeline"]["slots"] == tl.slots()
+        # the full body's timeline is a ring SUMMARY (slot list, no
+        # events) — the slot renderer must not crash on it
+        from tools.trace_view import render_slots
+
+        assert "no slot timeline events" in render_slots(body)
+        code, _ = handler.handle("scp", {"slot": "bogus"})
+        assert code == 400
+        for bad in ("0", "-3", "junk"):
+            code, _ = handler.handle("scp", {"limit": bad})
+            assert code == 400, f"limit={bad} accepted"
+    finally:
+        app.graceful_stop()
+
+
+def test_quorum_health_endpoint_and_metrics():
+    app, handler = _closed_node()
+    try:
+        # the monitor ran on every close (standalone: qset == self)
+        qh = app.herder.quorum_health
+        assert qh.evaluations >= 2
+        assert qh.last["available"] is True
+        assert qh.last["heard_fraction"] == 1.0
+        assert not qh.last["silent_v_blocking"]
+        snap = app.metrics.snapshot()
+        assert snap["quorum.health.available"]["value"] == 1.0
+        code, body = handler.handle(
+            "quorum-health", {"intersection": "true"})
+        assert code == 200
+        rep = body["quorum_health"]
+        assert rep["enabled"] and rep["intersection"]["ok"] is True
+        assert snap["quorum.health.evaluations"]["count"] >= 2
+    finally:
+        app.graceful_stop()
+
+
+def test_quorum_health_degraded_before_hearing_peers():
+    """Core-4 threshold-3 qset, nothing heard yet: the local slice is
+    unsatisfiable from {self} and the silent set is v-blocking."""
+    from stellar_core_tpu.simulation.simulation import core
+
+    sim = core(4)
+    nid = sorted(sim.nodes)[0]
+    qh = sim.nodes[nid].herder.quorum_health
+    rep = qh.evaluate(1)
+    assert rep["qset_members"] == 4 and rep["heard"] == 1
+    assert rep["available"] is False
+    assert rep["silent_v_blocking"] is True
+    assert len(rep["silent"]) == 3
+    m = sim.nodes[nid].metrics.snapshot()
+    assert m["quorum.health.available"]["value"] == 0.0
+    assert m["quorum.health.silent-v-blocking"]["value"] == 1.0
+
+
+def test_vitals_slo_quorum_availability_breach():
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME),
+                     test_config(VITALS_ENABLED=True))
+    app.start()
+    try:
+        app.vitals.sample_once()
+        assert app.vitals.breach_counts().get("quorum-availability") \
+            is None
+        app.metrics.counter("quorum.health.evaluations").inc()
+        app.metrics.gauge("quorum.health.available").set(0.0)
+        app.vitals.sample_once()
+        assert app.vitals.breach_counts()["quorum-availability"] == 1
+    finally:
+        app.graceful_stop()
+
+
+# ---------------------------------------------------------------------------
+# bounded-cardinality metric families
+# ---------------------------------------------------------------------------
+
+def test_bounded_name_admits_then_overflows():
+    reg = MetricsRegistry()
+    assert reg.bounded_name("fam", "a", cap=2) == "fam.a"
+    assert reg.bounded_name("fam", "b", cap=2) == "fam.b"
+    assert reg.bounded_name("fam", "c", cap=2) == "fam.other"
+    # admitted members stay admitted; the cap is on DISTINCT members
+    assert reg.bounded_name("fam", "a", cap=2) == "fam.a"
+    reg.reset()
+    assert reg.bounded_name("fam", "c", cap=2) == "fam.c"
+
+
+def test_bounded_name_sanitizes_hostile_members():
+    reg = MetricsRegistry()
+    assert reg.bounded_name("fam", "op code\nevil", cap=4) == \
+        "fam.op_code_evil"
+    assert reg.bounded_name("fam", "", cap=4) == "fam.unknown"
+
+
+def test_metrics_payload_bounded_under_adversarial_op_mix():
+    """An adversarial mix minting 500 distinct (op, reason) pairs must
+    not grow the registry — or the /metrics payload — past the cap."""
+    reg = MetricsRegistry()
+    for i in range(500):
+        reg.counter(reg.bounded_name(
+            "apply.native.decline", f"op{i}.why{i % 7}", cap=48)).inc()
+    names = [n for n in reg._metrics
+             if n.startswith("apply.native.decline")]
+    assert len(names) == 49  # 48 admitted + the "other" bucket
+    assert reg._metrics["apply.native.decline.other"].count == 500 - 48
+    exposition = render_prometheus(reg)
+    # payload growth is the admitted family only, not the mix size
+    assert exposition.count("apply_native_decline") < 200
+    before = len(exposition)
+    for i in range(500, 1000):
+        reg.counter(reg.bounded_name(
+            "apply.native.decline", f"op{i}.x", cap=48)).inc()
+    assert len(render_prometheus(reg)) == before
+
+
+def test_peer_gauge_export_is_bounded_by_family_cap():
+    """overlay.peer.* gauges ride bounded_name too: peer churn past
+    the cap lands in the per-family `other` member."""
+    reg = MetricsRegistry()
+    for i in range(40):
+        reg.gauge(reg.bounded_name(
+            "overlay.peer.queue_depth", f"{i:08x}", cap=17)).set(1.0)
+    fam = [n for n in reg._metrics
+           if n.startswith("overlay.peer.queue_depth")]
+    assert len(fam) == 18
+
+
+def test_peer_gauge_export_churn_zeroes_stale_and_folds_overflow():
+    """Peer churn against export_peer_gauges: a disconnected peer's
+    gauges drop to zero (not freeze at last values), and a churned-in
+    peer past the admission cap folds into the `other` roll-up
+    (instead of overwriting it)."""
+    from stellar_core_tpu.overlay.manager import OverlayManager
+
+    om = OverlayManager.__new__(OverlayManager)
+    om.app = type("A", (), {})()
+    om.app.metrics = reg = MetricsRegistry()
+    om._exported_peer_gauges = set()
+    om.PEER_VITALS_CAP = 2
+    vit = {"aaaa0001": {"queue_depth": 3.0},
+           "bbbb0002": {"queue_depth": 5.0}}
+    om.peer_vitals = lambda cap=None: dict(vit)
+    om.export_peer_gauges()
+    assert reg._metrics["overlay.peer.queue_depth.aaaa0001"].value == 3.0
+    assert reg._metrics["overlay.peer.queue_depth.bbbb0002"].value == 5.0
+    # churn: bbbb disconnects, cccc arrives past the (full) cap, and
+    # peer_vitals itself already rolled dddd+eeee up into `other`
+    vit = {"aaaa0001": {"queue_depth": 7.0},
+           "cccc0003": {"queue_depth": 11.0},
+           "other": {"peers": 2, "queue_depth": 13.0}}
+    om.export_peer_gauges()
+    assert reg._metrics["overlay.peer.queue_depth.aaaa0001"].value == 7.0
+    assert reg._metrics["overlay.peer.queue_depth.bbbb0002"].value == 0.0
+    assert "overlay.peer.queue_depth.cccc0003" not in reg._metrics
+    assert reg._metrics["overlay.peer.queue_depth.other"].value == 24.0
+
+
+# ---------------------------------------------------------------------------
+# forensics: scenario inertness + induced-fork attribution
+# ---------------------------------------------------------------------------
+
+def _scenario_fingerprint(tmpdir, seed=11, **kw):
+    from stellar_core_tpu.simulation.chaos import run_standard_scenario
+    from stellar_core_tpu.simulation.simulation import core
+
+    rep = run_standard_scenario(
+        lambda: core(4, persist_dir=str(tmpdir), MANUAL_CLOSE=False, **kw),
+        "partition_heal", seed=seed, n_nodes=4, duration=15.0)
+    return rep["fingerprint"]
+
+
+def test_forensics_on_off_scenario_fingerprints_identical(tmp_path):
+    """Satellite: a chaos scenario with forensics recording on (twice)
+    and off (once) produces bit-identical per-node ledger-hash
+    sequences — recording is inert at network scale too."""
+    on1 = _scenario_fingerprint(tmp_path / "a", SCP_TIMELINE_ENABLED=True)
+    on2 = _scenario_fingerprint(tmp_path / "b", SCP_TIMELINE_ENABLED=True)
+    off = _scenario_fingerprint(tmp_path / "c", SCP_TIMELINE_ENABLED=False)
+    assert on1 == on2 == off
+
+
+def test_induced_fork_dump_names_byzantine_node(tmp_path):
+    """Acceptance: the core-4 fork probe's FORENSICS_*.json must
+    attribute the first divergence to the equivocating node via
+    conflicting-statement evidence, and a same-seed rerun must
+    reproduce the dump byte-for-byte."""
+    from stellar_core_tpu.simulation.chaos import run_induced_fork
+    from stellar_core_tpu.simulation.simulation import core
+
+    digests, reports = [], []
+    for run in ("a", "b"):
+        d = tmp_path / run
+        d.mkdir()
+        rep, path = run_induced_fork(
+            lambda: core(4, threshold=2, persist_dir=str(d),
+                         MANUAL_CLOSE=False),
+            seed=14, duration=40.0, forensics_dir=str(d))
+        digests.append(hashlib.sha256(
+            open(path, "rb").read()).hexdigest())
+        reports.append(rep)
+    assert digests[0] == digests[1], "same-seed dump not byte-identical"
+    rep = reports[0]
+    byz = rep["nodes"]["byzantine"]
+    fd = rep["first_divergence"]
+    assert len(byz) == 1
+    assert fd["via"] == "equivocation"
+    assert fd["node"] in byz, \
+        f"divergence blamed {fd['node']}, byzantine was {byz}"
+    assert fd["slot"] <= rep["divergence"]["slot"]
+    # every equivocation group names the same (only) Byzantine node
+    assert {e["node"] for e in rep["equivocations"]} == set(byz)
+    # and the dump round-trips through the trace_view renderer
+    from tools.trace_view import render_slots
+
+    text = render_slots(json.loads(json.dumps(rep)))
+    assert f"FIRST DIVERGENCE: slot {fd['slot']}" in text
+    assert f"EQUIVOCATION: node {fd['node']}" in text
+    assert "== slot" in text
+
+
+def test_oracle_failure_dumps_forensics(tmp_path):
+    """A failing oracle inside run_scenario must leave a readable
+    FORENSICS_*.json behind and name the artifact in the raise."""
+    from stellar_core_tpu.simulation.chaos import run_scenario
+    from stellar_core_tpu.simulation.simulation import core
+
+    events = [(30.0, "never-fires", lambda chaos: None)]
+    with pytest.raises(AssertionError) as ei:
+        run_scenario(
+            lambda: core(4, persist_dir=str(tmp_path / "n"),
+                         MANUAL_CLOSE=False),
+            seed=5, events=events, duration=6.0, label="unfired_script",
+            forensics_dir=str(tmp_path))
+    assert "[forensics]" in str(ei.value)
+    dumps = list(tmp_path.glob("FORENSICS_unfired_script_seed5.json"))
+    assert len(dumps) == 1
+    doc = json.loads(dumps[0].read_text())
+    assert doc["forensics_schema"] == 1
+    assert doc["reason"].startswith("[unfired_script] only 0/1")
+    assert doc["timelines"], "dump carries no per-node timelines"
+    # no fork in this failure mode: divergence stays unattributed
+    assert doc["divergence"] is None

@@ -139,6 +139,54 @@ def test_current_tally_module_is_clean():
     assert not findings, [f.render() for f in findings]
 
 
+def test_telemetry_readback_is_caught():
+    """ISSUE 14: any data flow FROM the slot-timeline recorder INTO
+    consensus code (reading its state, returning it, passing it on)
+    breaks the telemetry-on/off bit-identity contract."""
+    src = _tally_source() + '''
+
+def _leak_state(slot):
+    tl = slot.scp.timeline
+    return tl.export()
+
+
+def _leak_as_argument(slot, fn):
+    fn(slot.scp.timeline)
+
+
+def _leak_len(slot):
+    return len(slot.scp.timeline._slots)
+'''
+    findings = lint_sources({TALLY: src})
+    hits = {f.context for f in findings
+            if f.rule == "det-telemetry-readback"}
+    assert {"_leak_state", "_leak_as_argument", "_leak_len"} <= hits, \
+        [f.render() for f in findings]
+    # and they are UNBASELINED (strict would exit nonzero)
+    fresh, _, _ = match_baseline(findings, load_baseline())
+    assert any(f.rule == "det-telemetry-readback" for f in fresh)
+
+
+def test_telemetry_writeonly_shapes_are_clean():
+    """The instrumented call-site shapes — alias, .enabled / is-None
+    guard, bare .record(...) statement, verdict write into the event
+    dict — must NOT be flagged."""
+    src = _tally_source() + '''
+
+def _record_ok(slot, kind):
+    tl = slot.scp.timeline
+    if tl.enabled:
+        ev = {"from": "aa"}
+        tl.record(slot.slot_index, kind, ev)
+        ev["ok"] = True
+    if tl is not None:
+        slot.scp.timeline.record(slot.slot_index, "env")
+'''
+    findings = lint_sources({TALLY: src})
+    assert not any(f.rule == "det-telemetry-readback" for f in findings), \
+        [f.render() for f in findings]
+
+
 # ---------------------------------------------------------------------------
 # determinism rules, unit-level
 # ---------------------------------------------------------------------------

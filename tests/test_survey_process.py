@@ -65,6 +65,70 @@ class TestSurvey:
         sm_b.relay_or_process_request(None, forged)
         assert len(sm_b._seen) == before  # bad signature: ignored
 
+    @staticmethod
+    def _live_line_sim(n=3):
+        """_line_sim with real (non-manual) closes, so SCP flood
+        traffic runs and the flood-dedup vitals accumulate."""
+        sim = Simulation(network_passphrase="survey net")
+        seeds = _seeds(n)
+        ids = _ids(seeds)
+        qset = {"threshold": 2, "validators": ids}
+        for s in seeds:
+            sim.add_node(s, qset, MANUAL_CLOSE=False)
+        for i in range(n - 1):
+            sim.add_connection(ids[i], ids[i + 1])
+        return sim, ids
+
+    def test_survey_collects_remote_peer_vitals(self):
+        """ISSUE 14 satellite: the survey response carries the surveyed
+        node's per-peer vitals (flood dedup, traffic, seconds
+        connected), so a surveying node can read REMOTE peer stats."""
+        sim, ids = self._live_line_sim()
+        sim.start_all_nodes()
+        # long enough for consensus flood traffic (SCP envelopes) to
+        # rack up unique + duplicate flood receives on every link
+        sim.crank_for(8.0)
+        a = sim.nodes[ids[0]]
+        sm = a.overlay_manager.survey_manager
+        assert sm.start_survey(ids[2])
+        sim.crank_for(3.0)
+        assert ids[2] in sm.results, "survey response never arrived"
+        peers = sm.results[ids[2]]["peers"]
+        # C's only authenticated peer is B, and the stats are B's as
+        # seen FROM C — matching C's own local peer vitals
+        assert [p["id"] for p in peers] == [ids[1].hex()[:8]]
+        p = peers[0]
+        c_local = sim.nodes[ids[2]].overlay_manager \
+            .peer_vitals()[ids[1].hex()[:8]]
+        assert p["unique_flood_recv"] > 0
+        assert p["bytes_read"] > 0 and p["bytes_written"] > 0
+        assert p["seconds_connected"] >= 8
+        # the response is C's snapshot at answer time; C's local
+        # counters kept growing during the extra cranking.  (A line
+        # topology has no redundant flood paths, so the duplicate
+        # counters stay 0 — uniques must be positive.)
+        assert 0 < p["unique_flood_recv"] <= c_local["unique_flood_recv"]
+        assert 0 < p["unique_flood_bytes"] <= c_local["unique_flood_bytes"]
+        for key in ("duplicate_flood_recv", "duplicate_flood_bytes"):
+            assert p[key] <= c_local[key], key
+
+    def test_peer_vitals_bounded_rollup(self):
+        """peer_vitals past the cap merge into one `other` bucket."""
+        sim, ids = self._live_line_sim()
+        sim.start_all_nodes()
+        sim.crank_for(5.0)
+        om = sim.nodes[ids[1]].overlay_manager  # B: two peers (A, C)
+        full = om.peer_vitals()
+        assert set(full) == {ids[0].hex()[:8], ids[2].hex()[:8]}
+        assert all(v["unique_flood_recv"] > 0 for v in full.values())
+        capped = om.peer_vitals(cap=1)
+        assert set(capped) == {sorted(full)[0], "other"}
+        other = capped["other"]
+        spill = full[sorted(full)[1]]
+        assert other["peers"] == 1
+        assert other["unique_flood_recv"] == spill["unique_flood_recv"]
+        assert other["bytes_read"] == spill["bytes_read"]
+
 
 class TestProcessManager:
     def test_run_and_reap(self, tmp_path):

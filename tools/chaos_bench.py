@@ -58,25 +58,68 @@ TIERS = {
 }
 
 
-def run_one(tier: str, scenario: str, seed: int, rerun: bool) -> dict:
+def _dump_rerun_mismatch(tier: str, scenario: str, seed: int,
+                         rep: dict, rep2: dict,
+                         forensics_dir: str) -> str:
+    """The rerun-mismatch oracle's forensic artifact: both runs'
+    per-node externalize maps plus the first (node, seq) whose hash
+    differed between the runs — determinism bugs get named, not just
+    detected."""
+    first = None
+    a, b = rep["per_node_externalized"], rep2["per_node_externalized"]
+    for node in sorted(set(a) | set(b)):
+        for s in sorted(set(a.get(node, {})) | set(b.get(node, {})),
+                        key=int):
+            ha, hb = a.get(node, {}).get(s), b.get(node, {}).get(s)
+            if ha != hb and first is None:
+                first = {"node": node, "slot": int(s),
+                         "run1": ha, "run2": hb}
+    doc = {"forensics_schema": 1,
+           "scenario": f"rerun_{tier}_{scenario}",
+           "seed": seed,
+           "reason": "same-seed rerun fingerprint mismatch",
+           "first_divergence": first,
+           "run1": {"fingerprint": rep["fingerprint"],
+                    "per_node_externalized": a},
+           "run2": {"fingerprint": rep2["fingerprint"],
+                    "per_node_externalized": b}}
+    os.makedirs(forensics_dir, exist_ok=True)
+    path = os.path.join(
+        forensics_dir,
+        f"FORENSICS_rerun_{tier}_{scenario}_seed{seed}.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def run_one(tier: str, scenario: str, seed: int, rerun: bool,
+            forensics_dir: str) -> dict:
     factory, n, duration = TIERS[tier]
     t0 = time.monotonic()
     with tempfile.TemporaryDirectory() as d:
         rep = run_standard_scenario(
             lambda: factory(d), scenario, seed=seed, n_nodes=n,
-            duration=duration)
+            duration=duration, forensics_dir=forensics_dir)
     rep["bench_wall_s"] = round(time.monotonic() - t0, 1)
     rep["tier"] = tier
     if rerun:
         with tempfile.TemporaryDirectory() as d:
             rep2 = run_standard_scenario(
                 lambda: factory(d), scenario, seed=seed, n_nodes=n,
-                duration=duration)
-        assert rep2["fingerprint"] == rep["fingerprint"], (
-            f"[{tier}/{scenario}] chaos seed {seed} NOT deterministic: "
-            f"{rep['fingerprint']} vs {rep2['fingerprint']}")
+                duration=duration, forensics_dir=forensics_dir)
+        if rep2["fingerprint"] != rep["fingerprint"]:
+            path = _dump_rerun_mismatch(tier, scenario, seed, rep, rep2,
+                                        forensics_dir)
+            raise AssertionError(
+                f"[{tier}/{scenario}] chaos seed {seed} NOT "
+                f"deterministic: {rep['fingerprint']} vs "
+                f"{rep2['fingerprint']}\n[forensics] {path}")
         rep["rerun_identical"] = True
-    del rep["events"]  # scripted, identical across runs; keep JSON lean
+    # scripted events + raw externalize maps are identical across runs
+    # (or dumped above on mismatch); keep the persisted JSON lean
+    del rep["events"]
+    del rep["per_node_externalized"]
     return rep
 
 
@@ -91,6 +134,9 @@ def main() -> int:
     ap.add_argument("--no-rerun", action="store_true",
                     help="skip the same-seed determinism rerun")
     ap.add_argument("--out", default=OUT)
+    ap.add_argument("--forensics-dir",
+                    default=os.path.dirname(OUT),
+                    help="where oracle failures dump FORENSICS_*.json")
     args = ap.parse_args()
 
     tiers = args.tier or sorted(TIERS)
@@ -100,7 +146,8 @@ def main() -> int:
         for scenario in scenarios:
             print(f"[chaos_bench] {tier}/{scenario} (seed {args.seed}) ...",
                   flush=True)
-            rep = run_one(tier, scenario, args.seed, not args.no_rerun)
+            rep = run_one(tier, scenario, args.seed, not args.no_rerun,
+                          args.forensics_dir)
             results.append(rep)
             print(f"[chaos_bench]   ledgers={rep['ledgers_closed']} "
                   f"heal={rep['time_to_heal_s']}s "

@@ -31,6 +31,15 @@ det-jit-host-effect
                   np.random/environ) inside a jax.jit-decorated function
                   in ops/ — traced once, silently stale or nondeterministic
                   after compilation caching.
+det-telemetry-readback
+                  the SCP timeline recorder (scp/timeline.py) must stay
+                  WRITE-ONLY from consensus code: a ``.timeline``
+                  reference may be aliased to a local, guarded on
+                  ``.enabled`` / ``is None``, and called as a bare
+                  ``.record(...)`` statement — any other use (return,
+                  argument, arithmetic, iteration, reading its state)
+                  is a data flow from telemetry into consensus and
+                  breaks the telemetry-on/off bit-identity contract.
 """
 from __future__ import annotations
 
@@ -445,14 +454,109 @@ class _JitVisitor(ContextVisitor):
 
 
 # ---------------------------------------------------------------------------
+# det-telemetry-readback
+# ---------------------------------------------------------------------------
+
+class _TelemetryReadback(ContextVisitor):
+    """Flag any data flow FROM the slot-timeline recorder INTO
+    consensus code.  Allowed shapes (everything the instrumented call
+    sites need, nothing more):
+
+      tl = <chain>.timeline          # alias to a local name
+      if tl.enabled: ...             # / <chain>.timeline.enabled
+      if tl is None / is not None:   # existence guard
+      tl.record(...)                 # / <chain>.timeline.record(...)
+                                     # as a bare expression statement
+
+    Every other appearance of a timeline reference — returned, passed
+    to another call, iterated, subscripted, read for its state — is a
+    finding: the recorder must be taint-sink-free."""
+
+    def visit_Module(self, node) -> None:
+        self._scan(node)
+        self.generic_visit(node)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.stack.append(node.name)
+        self._scan(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def _visit_func(self, node) -> None:
+        self.stack.append(node.name)
+        self._scan(node)
+        self.stack.pop()
+        ContextVisitor._visit_func(self, node)
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def _scan(self, scope) -> None:
+        aliases: Set[str] = set()
+        for n in _shallow_walk(scope):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name) and \
+                    isinstance(n.value, ast.Attribute) and \
+                    n.value.attr == "timeline":
+                aliases.add(n.targets[0].id)
+
+        def ref(n: ast.AST) -> bool:
+            return (isinstance(n, ast.Attribute)
+                    and n.attr == "timeline") or \
+                   (isinstance(n, ast.Name) and n.id in aliases)
+
+        ok_ids: Set[int] = set()
+        for n in _shallow_walk(scope):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name) and ref(n.value):
+                ok_ids.add(id(n.value))
+            elif isinstance(n, ast.Expr) and isinstance(n.value, ast.Call):
+                f = n.value.func
+                if isinstance(f, ast.Attribute) and f.attr == "record" \
+                        and ref(f.value):
+                    ok_ids.add(id(f.value))
+            elif isinstance(n, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+                self._mark_guard(n.test, ref, ok_ids)
+        for n in _shallow_walk(scope):
+            # Store/Del contexts write INTO the name (aliasing, or
+            # installing the recorder attribute) — no data flows OUT
+            # of the recorder there
+            if isinstance(getattr(n, "ctx", None), (ast.Store, ast.Del)):
+                continue
+            if ref(n) and id(n) not in ok_ids:
+                self.add(
+                    "det-telemetry-readback", n,
+                    "timeline recorder state must not flow into "
+                    "consensus code (allowed: alias, .enabled / "
+                    "is-None guard, bare .record(...) statement)")
+
+    @staticmethod
+    def _mark_guard(test: ast.AST, ref, ok_ids: Set[int]) -> None:
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Attribute) and sub.attr == "enabled" \
+                    and ref(sub.value):
+                ok_ids.add(id(sub.value))
+            elif isinstance(sub, ast.Compare) and ref(sub.left) and \
+                    all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in sub.ops) and \
+                    all(isinstance(c, ast.Constant) and c.value is None
+                        for c in sub.comparators):
+                ok_ids.add(id(sub.left))
+
+
+# ---------------------------------------------------------------------------
 
 def check(info: FileInfo) -> List[Finding]:
     findings: List[Finding] = []
     if info.in_consensus():
         imports = _ImportMap(info.tree)
-        for visitor in (_WallclockVisitor(info, imports),
-                        _UnsortedIterVisitor(info),
-                        _FloatVisitor(info)):
+        visitors = [_WallclockVisitor(info, imports),
+                    _UnsortedIterVisitor(info),
+                    _FloatVisitor(info)]
+        if not info.path.endswith("scp/timeline.py"):
+            # the recorder module itself is the one legitimate reader
+            visitors.append(_TelemetryReadback(info))
+        for visitor in visitors:
             visitor.visit(info.tree)
             findings.extend(visitor.findings)
     if info.in_kernels():

@@ -1,12 +1,22 @@
 #!/usr/bin/env python
-"""ASCII flame summary for persisted flight-recorder traces.
+"""ASCII flame summary for persisted flight-recorder traces, plus a
+slot-timeline renderer for SCP forensics.
 
 The slow-close watchdog (stellar_core_tpu/utils/tracing.py) persists
 Chrome ``trace_event`` JSON; chrome://tracing / Perfetto render it, but
 the container has no browser.  This renders the same file as an
 indented tree with proportional bars plus a top-self-time table.
 
+``--slots`` switches to the consensus-forensics view: the input is
+either one node's ``scp?slot=N`` / ``scp`` endpoint body or a
+network-wide ``FORENSICS_*.json`` dump (simulation/chaos.py).  Events
+from every node merge into one per-slot timeline, ordered by virtual
+time, with the first-divergence attribution and equivocation evidence
+printed up top — a failing chaos schedule read as a story.
+
 Usage: python tools/trace_view.py <trace.json> [--width N] [--top K]
+       python tools/trace_view.py --slots <FORENSICS_*.json|scp.json>
+           [--slot N] [--node HEX8]
 """
 import argparse
 import json
@@ -117,13 +127,125 @@ def render(trace: dict, width: int = 40, top: int = 10) -> str:
     return "\n".join(lines)
 
 
+# ---------------------------------------------------------------------------
+# slot-timeline view (consensus forensics)
+# ---------------------------------------------------------------------------
+
+def _short(v, n: int = 12):
+    """Truncate long value tags for display (full tags live in the
+    JSON)."""
+    if isinstance(v, str) and len(v) > n:
+        return v[:n] + ".."
+    if isinstance(v, list):
+        return [_short(x, n) for x in v]
+    if isinstance(v, dict):
+        return {k: _short(x, n) for k, x in v.items()}
+    return v
+
+
+def _event_detail(ev: dict) -> str:
+    parts = []
+    for k in sorted(ev):
+        if k in ("t", "kind"):
+            continue
+        parts.append(f"{k}={_short(ev[k])}")
+    return " ".join(parts)
+
+
+def _node_timelines(doc: dict) -> Dict[str, dict]:
+    """Normalize the three accepted shapes to {node: timeline export}:
+    a FORENSICS dump ('timelines'), a full `scp` body ('timeline' with
+    ring summary is NOT enough — needs slots), or one node's
+    `scp?slot=N` body ('timeline' carrying slot events)."""
+    if "timelines" in doc:
+        return doc["timelines"]
+    tl = doc.get("timeline", {})
+    if "events" in tl:  # scp?slot=N single-slot body
+        return {"local": {"slots": {str(tl.get("slot", doc.get("slot", 0))):
+                                    {"events": tl.get("events", []),
+                                     "dropped": tl.get("dropped", 0)}}}}
+    if isinstance(tl.get("slots"), dict):  # a raw SCPTimeline.export()
+        return {"local": tl}
+    # the full `scp` body's timeline is a ring SUMMARY ("slots" is a
+    # list of indices, no events) — nothing renderable
+    if "slots" in doc and isinstance(doc.get("slots"), dict) and any(
+            "events" in v for v in doc["slots"].values()):
+        return {"local": doc}
+    return {}
+
+
+def render_slots(doc: dict, slot: Optional[int] = None,
+                 node: Optional[str] = None) -> str:
+    lines: List[str] = []
+    fd = doc.get("first_divergence")
+    if fd:
+        lines.append(f"FIRST DIVERGENCE: slot {fd.get('slot')} via "
+                     f"{fd.get('via')} -> node {fd.get('node')}")
+    for e in doc.get("equivocations", []):
+        wit = {w for s in e.get("statements", [])
+               for w in s.get("witnesses", [])}
+        lines.append(
+            f"EQUIVOCATION: node {e['node']} slot {e['slot']} "
+            f"[{e['proto']}] {e.get('conflicting_pairs', 0)} conflicting "
+            f"pair(s), witnessed by {', '.join(sorted(wit))}")
+    if doc.get("reason"):
+        lines.append(f"reason: {doc['reason']}")
+    if lines:
+        lines.append("")
+
+    timelines = _node_timelines(doc)
+    if node is not None:
+        timelines = {n: t for n, t in timelines.items()
+                     if n.startswith(node)}
+    # merge: slot -> [(t, node, kind, detail)]
+    merged: Dict[int, List[tuple]] = {}
+    order = 0
+    for n8 in sorted(timelines):
+        for slot_str, slot_doc in sorted(
+                timelines[n8].get("slots", {}).items(), key=lambda kv:
+                int(kv[0])):
+            s = int(slot_str)
+            if slot is not None and s != slot:
+                continue
+            for ev in slot_doc.get("events", []):
+                order += 1
+                merged.setdefault(s, []).append(
+                    (float(ev.get("t", 0.0)), order, n8,
+                     ev.get("kind", "?"), _event_detail(ev)))
+            if slot_doc.get("dropped"):
+                merged.setdefault(s, []).append(
+                    (float("inf"), order, n8, "(truncated)",
+                     f"dropped={slot_doc['dropped']} oldest events"))
+    if not merged:
+        lines.append("no slot timeline events in this file")
+        return "\n".join(lines)
+    for s in sorted(merged):
+        lines.append(f"== slot {s} ==")
+        lines.append(f"  {'t(s)':>10}  {'node':<10}{'event':<24}detail")
+        for t, _o, n8, kind, detail in sorted(merged[s]):
+            ts = "" if t == float("inf") else f"{t:10.3f}"
+            lines.append(f"  {ts:>10}  {n8:<10}{kind:<24}{detail}")
+        lines.append("")
+    return "\n".join(lines)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace", help="Chrome trace_event JSON file")
+    ap.add_argument("trace", help="Chrome trace_event JSON file, or a "
+                                  "FORENSICS_*.json / scp endpoint body "
+                                  "with --slots")
     ap.add_argument("--width", type=int, default=40,
                     help="flame bar width in columns")
     ap.add_argument("--top", type=int, default=10,
                     help="rows in the self-time table")
+    ap.add_argument("--slots", action="store_true",
+                    help="render a consensus slot timeline instead of "
+                         "a flame tree")
+    ap.add_argument("--slot", type=int, default=None,
+                    help="with --slots: only this slot")
+    ap.add_argument("--node", default=None,
+                    help="with --slots: only nodes whose hex8 id "
+                         "starts with this prefix")
     args = ap.parse_args()
     try:
         with open(args.trace, encoding="utf-8") as f:
@@ -132,7 +254,10 @@ def main() -> int:
         print(f"trace_view: cannot read {args.trace}: {e}",
               file=sys.stderr)
         return 2
-    print(render(trace, width=args.width, top=args.top))
+    if args.slots:
+        print(render_slots(trace, slot=args.slot, node=args.node))
+    else:
+        print(render(trace, width=args.width, top=args.top))
     return 0
 
 

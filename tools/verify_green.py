@@ -320,6 +320,50 @@ def run_chaos_smoke() -> "tuple":
     return problems, summary
 
 
+def run_forensics_smoke() -> "tuple":
+    """The consensus-forensics gate (tools/scp_forensics_bench.py
+    --smoke): a deliberately-unsafe core-4 net with a full Byzantine
+    bridge MUST fork, the FORENSICS_*.json dump must attribute the
+    first divergence to the Byzantine node via equivocation evidence,
+    and a same-seed rerun must reproduce the dump byte-for-byte.  The
+    recorder-overhead A/B rides along (informational at smoke scale;
+    the <2% acceptance gate is the full 1000-tx bench artifact).
+    Returns (problems, summary)."""
+    out = "/tmp/_t1_forensics_smoke.json"
+    cmd = [sys.executable, "-m", "tools.scp_forensics_bench",
+           "--smoke", "--out", out]
+    print(f"verify_green: [forensics smoke] {' '.join(cmd)}", flush=True)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=600)
+    try:
+        with open(out) as f:
+            rep = json.load(f)
+    except (OSError, ValueError) as e:
+        tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-6:])
+        return [f"forensics smoke report unreadable: {e}: {tail}"], \
+            "failed"
+    problems = []
+    probe = rep.get("fork_probe", {})
+    if not probe.get("attributed_to_byzantine"):
+        problems.append(
+            f"forensics smoke: fork NOT attributed to the Byzantine "
+            f"node (first_divergence={probe.get('first_divergence')}, "
+            f"byzantine={probe.get('byzantine')})")
+    if probe.get("rerun_dump_identical") is not True:
+        problems.append(
+            "forensics smoke: same-seed FORENSICS dump not "
+            "byte-identical")
+    overhead = rep.get("overhead", {}).get("overhead_pct_p50")
+    summary = (f"fork at slot {probe.get('divergence_slot')} attributed "
+               f"to {probe.get('first_divergence', {}).get('node')} "
+               f"(byz={probe.get('byzantine')}), dump deterministic="
+               f"{probe.get('rerun_dump_identical')}, recorder overhead "
+               f"{overhead}% (smoke scale)")
+    return problems, summary
+
+
 def run_soak_smoke() -> "tuple":
     """A ~30-clock-second sustained-load soak (tools/soak_bench.py
     --smoke): rate-mode load on a disk-backed REAL_TIME node, then the
@@ -396,6 +440,7 @@ def main() -> int:
     skip_pipeline = "--skip-pipeline-smoke" in sys.argv
     skip_soak = "--skip-soak-smoke" in sys.argv
     skip_credit = "--skip-credit-smoke" in sys.argv
+    skip_forensics = "--skip-forensics-smoke" in sys.argv
     if smoke_only:
         cmd = tier1_command()
         problems, passed, summary = run_parallel_smoke(cmd)
@@ -486,6 +531,11 @@ def main() -> int:
         print(f"verify_green: soak smoke: {soak_summary}", flush=True)
         problems.extend(soak_problems)
         smoke_note += f", soak smoke: {soak_summary}"
+    if not skip_forensics:
+        fo_problems, fo_summary = run_forensics_smoke()
+        print(f"verify_green: forensics smoke: {fo_summary}", flush=True)
+        problems.extend(fo_problems)
+        smoke_note += f", forensics smoke: {fo_summary}"
     if problems:
         print(f"verify_green: RED ({'; '.join(problems)}); "
               f"passed={passed}", flush=True)
