@@ -58,16 +58,19 @@ class ApplySnapshot:
     """Immutable pre-apply state shared by every cluster (built on the
     main thread, read-only afterwards — no locking needed)."""
 
-    __slots__ = ("store", "header", "books", "idpool0")
+    __slots__ = ("store", "header", "books", "idpool0", "pool_quote")
 
     def __init__(self, store: Dict[bytes, object], header, books,
-                 idpool0: int):
+                 idpool0: int, pool_quote: bool = True):
         self.store = store
         self.header = header
         # pair token -> {direction (selling, buying): sorted
         #               [(Fraction, offerID, kb)]}
         self.books = books
         self.idpool0 = idpool0
+        # NATIVE_POOL_QUOTE kill switch: False restores the pre-r16
+        # decline-if-live-pool host screen (native_apply._screen_cluster)
+        self.pool_quote = pool_quote
 
 
 def _is_fresh_offer_key(kb: bytes, idpool0: int) -> bool:
@@ -508,7 +511,10 @@ class ParallelApplyManager:
         books = {pair: mat.offers
                  for pair, mat in plan.context.books.items()}
         header = ltx.header()
-        return ApplySnapshot(store, header, books, header.idPool)
+        pool_quote = bool(getattr(self.app.config, "NATIVE_POOL_QUOTE",
+                                  True))
+        return ApplySnapshot(store, header, books, header.idPool,
+                             pool_quote)
 
     def _run_task(self, clusters, snapshot, apply_order, verify,
                   invariant_check, abort, tracer,

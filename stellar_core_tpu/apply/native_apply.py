@@ -14,9 +14,20 @@ The kernel-shaped strip (ISSUE 13 kernel-complete apply): native AND
 credit payments, CHANGE_TRUST create/update/delete over classic
 assets, MANAGE_SELL_OFFER create/modify/delete (offerID 0 and !=0),
 and PATH_PAYMENT strict-send/strict-receive over declared hop pairs
-(per-hop pool descriptors ride the shape so the kernel can decline a
-hop whose pair has a LIVE liquidity pool — pool quoting stays
-host-side).
+(per-hop pool descriptors ride the shape so the kernel can quote a
+LIVE constant-product pool on the hop in-kernel — book-vs-pool
+arbitration mirrors ``convert_with_offers_and_pools``; pool
+deposit/withdraw stay host-side, and ``NATIVE_POOL_QUOTE=0`` restores
+the old decline-if-live behavior via a host screen).
+
+Beyond per-cluster apply, ``run_fee_phase_native`` batches the whole
+fee/seqnum phase of a close into ONE GIL-released ``charge_fees``
+kernel call: apply-ordered tx descriptors + the packed source-account
+snapshot go in, per-tx pre-encoded ``feeProcessing``
+LedgerEntryChanges plus packed account deltas come out.  Any
+unsupported account shape declines the WHOLE fee batch back to the
+per-tx ``frame.process_fee_seq_num`` loop — bytes identical either
+way.
 
 Parity contract: the kernel implements success paths only.  Any
 structural mismatch, unexpected entry state, failing check or
@@ -108,7 +119,10 @@ def _constants_in_lockstep() -> bool:
             and int(T.PASSIVE_FLAG) == 1
             and int(OT.CHANGE_TRUST) == 6
             and int(OT.PATH_PAYMENT_STRICT_RECEIVE) == 2
-            and int(OT.PATH_PAYMENT_STRICT_SEND) == 13)
+            and int(OT.PATH_PAYMENT_STRICT_SEND) == 13
+            and int(T.LedgerEntryType.LIQUIDITY_POOL) == 5
+            and int(T.ClaimAtomType.CLAIM_ATOM_TYPE_LIQUIDITY_POOL) == 2
+            and T.LIQUIDITY_POOL_FEE_V18 == 30)
 
 
 def kernel_module():
@@ -202,8 +216,8 @@ def _path_hops(chain) -> tuple:
     """The effective conversion steps of a path-payment chain: adjacent
     equal assets collapse (exactly the reference's ``assets_equal``
     skip), and each hop carries its pair's liquidity-pool key so the
-    kernel can run its decline-if-live pool probe against a DECLARED
-    key."""
+    kernel can quote a LIVE pool against a DECLARED key (and the
+    ``NATIVE_POOL_QUOTE=0`` host screen can probe the same key)."""
     from ..transactions import liquidity_pool as LP
     from ..transactions import utils as U
 
@@ -283,6 +297,17 @@ def _screen_cluster(cluster, snapshot, apply_order, verify):
             # destination accounts are touched by every payment-shaped
             # apply; screen their persistent unsupported shapes too
             _screen_account(snapshot, shape[1], idx)
+        if shape[0] == "pathpay" and not getattr(snapshot, "pool_quote",
+                                                 True):
+            # NATIVE_POOL_QUOTE=0 kill switch: restore the pre-r16
+            # decline-if-live-pool behavior so the Python reference
+            # adjudicates every pool-backed hop
+            for _, _, pool_kb in shape[7]:
+                if snapshot.store.get(pool_kb) is not None:
+                    raise KernelDecline(
+                        f"tx {idx}: liquidity pool on hop "
+                        f"(pool quoting off)", op="pathpay",
+                        code="liquidity_pool_on_hop")
     return frames
 
 
@@ -485,3 +510,77 @@ def run_clusters_native_batched(clusters, snapshot, apply_order, verify,
                       frames, records[pos:pos + n])
         pos += n
     return [results[c.cluster_id] for c in clusters]
+
+
+def run_fee_phase_native(ltx, apply_order, base_fee):
+    """Charge the WHOLE fee/seqnum phase in one GIL-released kernel
+    call (apply_kernel.cpp ``charge_fees`` — the batched twin of the
+    per-tx ``frame.process_fee_seq_num`` loop).
+
+    On success: sets ``frame.fee_charged`` on every frame, installs the
+    packed post-charge account images + the feePool bump into ``ltx``,
+    and returns the per-tx ``feeProcessing`` LedgerEntryChanges (each a
+    pre-encoded ``[STATE, UPDATED]`` pair riding ``LazyUnion``) in the
+    exact shape the Python loop returns.  Raises ``KernelDecline`` with
+    ``ltx`` untouched otherwise — any unsupported account shape
+    declines the whole batch to the reference loop (bytes identical
+    either way; tests/test_native_fee.py holds the parity)."""
+    from ..transactions import utils as U
+    from ..transactions.frame import TransactionFrame
+
+    mod = kernel_module()
+    if mod is None:
+        raise KernelDecline("kernel unavailable", op="fee")
+    if not _constants_in_lockstep():
+        raise KernelDecline("protocol constant drift", op="fee")
+    header = ltx.header()
+    if header.ledgerVersion != 19:
+        raise KernelDecline(
+            f"protocol version {header.ledgerVersion} not kernel-backed",
+            op="fee")
+
+    acct_idx: dict = {}
+    acct_keys: List[bytes] = []
+    accounts: List[bytes] = []
+    fee_txs: List[tuple] = []
+    for idx, frame in enumerate(apply_order):
+        if type(frame) is not TransactionFrame:
+            # fee bumps charge a second fee source; reference loop owns
+            raise KernelDecline(f"tx {idx} not kernel-shaped", op="fee",
+                                code="not_kernel_shaped")
+        src = frame.source_account_id()
+        i = acct_idx.get(src)
+        if i is None:
+            kb = account_key_bytes(src)
+            entry = ltx.get(kb)
+            if entry is None:
+                # the reference raises "fee source vanished" — a halt,
+                # not a success path; keep it on the Python loop
+                raise KernelDecline(f"tx {idx} fee source missing",
+                                    op="fee", code="fee_source_missing")
+            i = acct_idx[src] = len(accounts)
+            acct_keys.append(kb)
+            accounts.append(T.LedgerEntry.encode(entry))
+        fee_txs.append((i, frame.get_full_fee(), frame.num_operations()))
+
+    # base_fee None means "no vote: charge the full fee"; an
+    # INT64_MAX stride makes the kernel's min() pick full_fee exactly
+    bf = U.INT64_MAX if base_fee is None else base_fee
+    out = mod.charge_fees((header.ledgerSeq, bf), accounts, fee_txs)
+    if not out[0]:
+        raise KernelDecline(f"kernel declined fee batch: {out[1]}",
+                            op="fee", code=_reason_slug(out[1]))
+    _, rows, finals, fee_pool_delta = out
+
+    fee_changes = []
+    for frame, (charged, state_b, upd_b) in zip(apply_order, rows):
+        frame.fee_charged = charged
+        fee_changes.append([LazyUnion(T.LedgerEntryChange, state_b),
+                            LazyUnion(T.LedgerEntryChange, upd_b)])
+    # the merge is the executor's delta-install idiom: packed images
+    # land in the close ltx, materialized only if someone reads them
+    for kb, eb in zip(acct_keys, finals):
+        ltx._delta[kb] = PackedEntry(eb)
+    ltx.set_header(header._replace(
+        feePool=header.feePool + fee_pool_delta))
+    return fee_changes

@@ -228,16 +228,31 @@ class LedgerManager:
             self._phase(phases, "verify", sp.seconds)
 
             # phase 1: fees + seqnums for every tx, in apply order
-            # (ref processFeesSeqNums :1164)
-            fee_changes: List[object] = []
+            # (ref processFeesSeqNums :1164) — one batched GIL-released
+            # kernel call when every tx fits (NATIVE_FEE), else the
+            # per-tx reference loop; bytes identical either way
             base_fee = prev_header.baseFee
             with tracer.span("ledger.close.fee") as sp, \
                     self.metrics.timer(
-                        "ledger.transaction.fee").time_scope():
-                for frame in apply_order:
-                    fee_changes.append(
-                        frame.process_fee_seq_num(ltx, base_fee))
+                        "ledger.transaction.fee").time_scope(), \
+                    tracing.collect_op_costs() as fee_costs:
+                fee_changes = self._charge_fees(ltx, apply_order,
+                                                base_fee)
             self._phase(phases, "fee", sp.seconds)
+            # cost attribution mirrors the apply phase's op breakdown:
+            # one batched kernel call still lands count=len(apply_order)
+            # so per-tx fee cost stays readable off the span tree
+            cursor = sp.t0
+            for name in sorted(fee_costs.costs):
+                total_s, count = fee_costs.costs[name]
+                tracer.aggregate_span(
+                    f"ledger.fee.op.{name}",
+                    sp.span_id or None, cursor, total_s, count=count)
+                cursor += total_s
+            # lifecycle stage "fee": the batch charges every tx at one
+            # instant, which is exactly the stamp contract (stages are
+            # close-level events sharing one timestamp)
+            self.app.txtracer.stamp_frames(apply_order, "fee")
 
             # phase 2: apply transactions (ref applyTransactions :1297)
             # with per-operation-type cost attribution: frame.apply's op
@@ -656,23 +671,100 @@ class LedgerManager:
             sl[0] = header.bucketListHash
         return header._replace(skipList=sl)
 
+    def _charge_fees(self, ltx, apply_order, base_fee) -> List[object]:
+        """Phase 1 (ref processFeesSeqNums): charge every tx's fee
+        against its source account, in apply order.
+
+        One batched GIL-released kernel call covers the whole set when
+        NATIVE_FEE (and the kernel itself) is on and every source
+        account has a kernel-supported shape — the kernel returns the
+        per-tx ``feeProcessing`` LedgerEntryChanges pre-encoded, bit-
+        identical to the reference loop's.  Any tx the kernel can't
+        charge declines the WHOLE batch (fees are strictly sequential:
+        a repeat source must see the prior tx's post-image) and the
+        per-tx reference loop below takes over.  NATIVE_FEE=0 is the
+        kill switch: skip the kernel silently, no decline counters —
+        off is not a coverage gap."""
+        from ..utils import tracing
+
+        metrics = self.metrics
+        cfg = self.app.config
+        col = tracing.op_collector()
+        if (apply_order and getattr(cfg, "NATIVE_FEE", True)
+                and getattr(cfg, "NATIVE_APPLY", True)):
+            from ..apply import native_apply as NA
+
+            with tracing.stopwatch() as sw:
+                try:
+                    fee_changes = NA.run_fee_phase_native(
+                        ltx, apply_order, base_fee)
+                except NA.KernelDecline as d:
+                    fee_changes = None
+                    code = getattr(d, "code", None) or "unknown"
+            if fee_changes is not None:
+                metrics.counter("apply.native.fee.hit").inc()
+                if col is not None:
+                    # the batch charged every tx at once: apportion the
+                    # crossing across the set (count keeps it per-tx)
+                    col.add_many("fee.charge", sw.seconds,
+                                 len(apply_order))
+                return fee_changes
+            # whole-batch decline -> reference loop; the taxonomy
+            # counter names the exact coverage gap (bounded family:
+            # past the cap new codes collapse into ...decline.other)
+            metrics.counter("apply.native.fee.decline").inc()
+            metrics.counter(metrics.bounded_name(
+                "apply.native.fee.decline", code, cap=24)).inc()
+        fee_changes = []
+        for frame in apply_order:
+            with tracing.stopwatch() as sw:
+                fee_changes.append(
+                    frame.process_fee_seq_num(ltx, base_fee))
+            if col is not None:
+                col.add("fee.charge", sw.seconds)
+        return fee_changes
+
     def _store_tx_history(self, seq: int, frames, metas,
                           encoded_rows=None) -> None:
         """``encoded_rows`` — (envelope, result-pair, meta) bytes the
         parallel executor pre-encoded on worker threads (overlapping the
         GIL-free native serialization with other clusters' apply); when
-        absent, encode here like the reference."""
+        absent, encode here: one batched native crossing that releases
+        the GIL for the copy-out (NATIVE_TAIL_ENCODE), else the per-row
+        reference loop — bytes identical either way."""
         cur = self.app.database.cursor()
-        if encoded_rows is not None:
-            rows = [(frame.full_hash(), seq, i, env_b, pair_b, meta_b)
-                    for i, (frame, (env_b, pair_b, meta_b))
-                    in enumerate(zip(frames, encoded_rows))]
-        else:
-            rows = [(frame.full_hash(), seq, i,
-                     T.TransactionEnvelope.encode(frame.envelope),
-                     T.TransactionResultPair.encode(meta.result),
-                     T.TransactionMeta.encode(meta.txApplyProcessing))
-                    for i, (frame, meta) in enumerate(zip(frames, metas))]
+        if encoded_rows is None:
+            encoded_rows = self._encode_commit_rows(frames, metas)
+        rows = [(frame.full_hash(), seq, i, env_b, pair_b, meta_b)
+                for i, (frame, (env_b, pair_b, meta_b))
+                in enumerate(zip(frames, encoded_rows))]
         cur.executemany(
             "INSERT INTO txhistory(txid, ledgerseq, txindex, txbody, "
             "txresult, txmeta) VALUES(?,?,?,?,?,?)", rows)
+
+    def _encode_commit_rows(self, frames, metas):
+        """The commit tail's remaining Python encode loop, batched:
+        every (envelope, result-pair, meta) triple of the close packs
+        through ONE native xdrpack call whose copy-out phase runs with
+        the GIL released (``pack_many``) — on the pipelined tail worker
+        that overlap is concurrent with ledger N+1's close.  Falls back
+        to the per-row reference encode when NATIVE_TAIL_ENCODE=0 or
+        the native packer is unavailable."""
+        if getattr(self.app.config, "NATIVE_TAIL_ENCODE", True):
+            from ..xdr import runtime
+
+            pairs = []
+            for frame, meta in zip(frames, metas):
+                pairs.append((T.TransactionEnvelope, frame.envelope))
+                pairs.append((T.TransactionResultPair, meta.result))
+                pairs.append((T.TransactionMeta, meta.txApplyProcessing))
+            flat = runtime.encode_many(pairs)
+            if flat is not None:
+                self.metrics.counter("apply.native.tail_encode.hit")\
+                    .inc()
+                return [tuple(flat[i:i + 3])
+                        for i in range(0, len(flat), 3)]
+        return [(T.TransactionEnvelope.encode(frame.envelope),
+                 T.TransactionResultPair.encode(meta.result),
+                 T.TransactionMeta.encode(meta.txApplyProcessing))
+                for frame, meta in zip(frames, metas)]

@@ -206,6 +206,27 @@ class Config:
         self.NATIVE_APPLY_INLINE: bool = kw.get(
             "NATIVE_APPLY_INLINE",
             _os.environ.get("NATIVE_APPLY_INLINE", "0") == "1")
+        # batched fee/seqnum phase (apply_kernel.cpp charge_fees): one
+        # GIL-released call replaces the per-tx process_fee_seq_num
+        # loop.  NATIVE_FEE=0 is the kill switch; bytes are identical
+        # either way (tests/test_native_fee.py).  Follows NATIVE_APPLY:
+        # the fee batch never engages with the apply kernel killed.
+        self.NATIVE_FEE: bool = kw.get(
+            "NATIVE_FEE",
+            _os.environ.get("NATIVE_FEE", "1") != "0")
+        # in-kernel constant-product pool quoting on path-payment hops;
+        # NATIVE_POOL_QUOTE=0 restores the decline-if-live-pool host
+        # screen (pool hops then always run the Python reference).
+        self.NATIVE_POOL_QUOTE: bool = kw.get(
+            "NATIVE_POOL_QUOTE",
+            _os.environ.get("NATIVE_POOL_QUOTE", "1") != "0")
+        # native tail encode (xdr_pack.c pack_many): the commit tail's
+        # tx-history row encodes collapse into one native crossing.
+        # NATIVE_TAIL_ENCODE=0 falls back to per-value encode() — same
+        # packer, same bytes.
+        self.NATIVE_TAIL_ENCODE: bool = kw.get(
+            "NATIVE_TAIL_ENCODE",
+            _os.environ.get("NATIVE_TAIL_ENCODE", "1") != "0")
         # one JSON line of session apply stats appended at shutdown —
         # tools/verify_green.py's parallel smoke aggregates these to
         # report aborts observed across the suite

@@ -102,6 +102,12 @@ class CommandHandler:
         # apply.native.decline.<op>.<reason>) registers on first event
         m.counter("apply.native.hit")
         m.counter("apply.native.decline")
+        # fee-phase kernel accounting (r16): pinned from boot so the
+        # scrape never misses them; the decline-reason breakout
+        # (apply.native.fee.decline.<code>) registers on first decline
+        m.counter("apply.native.fee.hit")
+        m.counter("apply.native.fee.decline")
+        m.counter("apply.native.tail_encode.hit")
         # bounded per-peer overlay vitals mirrored into the registry
         # (Prometheus rides the registry; the JSON body also carries
         # the full structured form below)
@@ -272,7 +278,7 @@ class CommandHandler:
         return 200, {"ledger": seq}
 
     def generateload(self, params):
-        """generateload?mode=create|pay|pretend|mixed|credit|pathpay
+        """generateload?mode=create|pay|pretend|mixed|credit|pathpay|pool
         &accounts=N&txs=N [&dexpct=N&opcount=N&trustpct=N&hops=N] —
         drives the LoadGenerator through the real tx queue (ref
         CommandHandler.cpp:125; the reference registers this only in
@@ -407,6 +413,15 @@ class CommandHandler:
                     f"ledger and call mode=pathpay again",
                     lambda: setattr(lg, "_path_stage", stage + 1))
             envs = lg.generate_path_payments(n_txs)
+        elif mode == "pool":
+            # path payments routed through LIVE constant-product pools
+            # (ISSUE 16): pools bulk-seed on first call (perf-rig
+            # style, no staged closes needed), then the workload is the
+            # same alternating strict-send/receive mix as mode=pathpay
+            # with the pools as the only crossing venue
+            if getattr(lg, "pool_ids", None) is None:
+                lg.setup_pool(hops=int(params.get("hops", "2")))
+            envs = lg.generate_pool_payments(n_txs)
         else:
             return 400, {"error": f"unknown mode {mode!r}"}
         return submit(envs)

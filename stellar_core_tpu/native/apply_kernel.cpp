@@ -27,8 +27,15 @@
  *   - PATH_PAYMENT_STRICT_SEND / _RECEIVE over declared hop pairs:
  *     the multi-hop chain walk with per-hop send/receive propagation,
  *     the strict-send/strict-receive rounding modes, max-path-length
- *     and self-crossing guards.  Hops whose pair has a LIVE liquidity
- *     pool decline (pool quoting stays host-side).
+ *     and self-crossing guards.  A LIVE constant-product pool on a hop
+ *     is QUOTED in-kernel (book-vs-pool arbitration mirroring
+ *     convert_with_offers_and_pools); pool deposit/withdraw stay
+ *     host-side.
+ *
+ * Beyond apply_cluster, charge_fees() batches the whole fee/seqnum
+ * phase: one GIL-released call charges every tx's fee against the
+ * packed source-account snapshot and returns per-tx pre-encoded
+ * feeProcessing LedgerEntryChanges plus final account images.
  *
  * Parity discipline: the kernel implements ONLY the success paths.
  * Any ineligible shape, unexpected entry state, failing check, or
@@ -75,7 +82,8 @@ enum {
     OP_PATH_PAYMENT_STRICT_SEND = 13,
 };
 /* LedgerEntryType */
-enum { LE_ACCOUNT = 0, LE_TRUSTLINE = 1, LE_OFFER = 2 };
+enum { LE_ACCOUNT = 0, LE_TRUSTLINE = 1, LE_OFFER = 2,
+       LE_LIQUIDITY_POOL = 5 };
 /* LedgerEntryChangeType */
 enum { CH_CREATED = 0, CH_UPDATED = 1, CH_REMOVED = 2, CH_STATE = 3 };
 /* trustline flags */
@@ -89,6 +97,16 @@ static const uint32_t ACC_AUTH_CLAWBACK_FLAG = 8;
 static const uint32_t PASSIVE_FLAG = 1;
 /* TrustLineEntry extension discriminants (liability XDR tags) */
 enum { TL_EXT_V1 = 1, TL_V1_EXT_V2 = 2 };
+/* AccountEntry extension discriminants (the v1/v2/v3 seqnum chain) */
+enum { ACC_EXT_V1 = 1, ACC_EXT_V2 = 2, ACC_EXT_V3 = 3 };
+/* liquidity pools: constant-product only; the quote math denominates
+ * fees in basis points and the protocol pins the pool fee at 30 bps
+ * (types.py LIQUIDITY_POOL_FEE_V18) */
+static const int32_t POOL_FEE_V18 = 30;
+static const int32_t POOL_MAX_BPS = 10000;
+/* fee charge: base_fee scales with max(FEE_OPS_FLOOR, numOperations)
+ * (frame.process_fee_seq_num) */
+static const int64_t FEE_OPS_FLOOR = 1;
 /* ManageOfferEffect */
 enum { EFF_CREATED = 0, EFF_UPDATED = 1, EFF_DELETED = 2 };
 /* offer_exchange.RoundingType */
@@ -240,7 +258,17 @@ struct OfferState {
     uint32_t flags = 0;
 };
 
-enum { K_OTHER = 0, K_ACCT = 1, K_TL = 2, K_OFFER = 3 };
+struct PoolState {
+    /* constant-product pool (the only pool body the protocol defines);
+     * params are canonical (assetA < assetB, fee = POOL_FEE_V18) */
+    std::string pool_id; /* raw 32 */
+    std::string assetA, assetB;
+    int32_t fee = 0;
+    int64_t reserveA = 0, reserveB = 0;
+    int64_t totalPoolShares = 0, poolSharesTrustLineCount = 0;
+};
+
+enum { K_OTHER = 0, K_ACCT = 1, K_TL = 2, K_OFFER = 3, K_POOL = 4 };
 
 struct Entry {
     int kind = K_OTHER;
@@ -251,6 +279,7 @@ struct Entry {
     AcctState acct;
     TlState tl;
     OfferState offer;
+    PoolState pool;
     std::string raw; /* original input bytes */
 };
 
@@ -352,20 +381,20 @@ static void encode_account(const Entry &e, Wr &w) {
     if (!a.has_v1) {
         w.u32(0);
     } else {
-        w.u32(1);
+        w.u32(ACC_EXT_V1);
         w.i64(a.liab_buying);
         w.i64(a.liab_selling);
         if (!a.has_v2) {
             w.u32(0);
         } else {
-            w.u32(2);
+            w.u32(ACC_EXT_V2);
             w.u32(a.numSponsored);
             w.u32(a.numSponsoring);
             w.u32(0); /* signerSponsoringIDs: [] */
             if (!a.has_v3) {
                 w.u32(0);
             } else {
-                w.u32(3);
+                w.u32(ACC_EXT_V3);
                 w.u32(0); /* ExtensionPoint v0 */
                 w.u32(a.seqLedger);
                 w.u64(a.seqTime);
@@ -422,6 +451,22 @@ static void encode_offer(const Entry &e, Wr &w) {
     w.u32(0); /* LedgerEntry ext v0 */
 }
 
+static void encode_pool(const Entry &e, Wr &w) {
+    const PoolState &p = e.pool;
+    w.u32(e.lastModified);
+    w.u32(LE_LIQUIDITY_POOL);
+    w.raw(p.pool_id);
+    w.u32(0); /* LIQUIDITY_POOL_CONSTANT_PRODUCT */
+    w.raw(p.assetA);
+    w.raw(p.assetB);
+    w.i32(p.fee);
+    w.i64(p.reserveA);
+    w.i64(p.reserveB);
+    w.i64(p.totalPoolShares);
+    w.i64(p.poolSharesTrustLineCount);
+    w.u32(0); /* LedgerEntry ext v0 */
+}
+
 static std::string encode_entry(const Entry &e) {
     Wr w;
     switch (e.kind) {
@@ -433,6 +478,9 @@ static std::string encode_entry(const Entry &e) {
         break;
     case K_OFFER:
         encode_offer(e, w);
+        break;
+    case K_POOL:
+        encode_pool(e, w);
         break;
     default:
         /* untouched passthrough: callers never re-encode K_OTHER */
@@ -482,18 +530,18 @@ static void parse_entry(Entry &e) {
             memcpy(a.thresholds, th.data(), 4);
             need(r.u32() == 0, "account has signers");
             uint32_t ext = r.u32();
-            if (ext == 1) {
+            if (ext == ACC_EXT_V1) {
                 a.has_v1 = true;
                 a.liab_buying = r.i64();
                 a.liab_selling = r.i64();
                 uint32_t e1 = r.u32();
-                if (e1 == 2) {
+                if (e1 == ACC_EXT_V2) {
                     a.has_v2 = true;
                     a.numSponsored = r.u32();
                     a.numSponsoring = r.u32();
                     need(r.u32() == 0, "signerSponsoringIDs present");
                     uint32_t e2 = r.u32();
-                    if (e2 == 3) {
+                    if (e2 == ACC_EXT_V3) {
                         a.has_v3 = true;
                         need(r.u32() == 0, "extension point");
                         a.seqLedger = r.u32();
@@ -562,6 +610,21 @@ static void parse_entry(Entry &e) {
             need(r.done(), "trailing entry bytes");
             e.kind = K_OFFER;
             e.offer = o;
+        } else if (t == LE_LIQUIDITY_POOL) {
+            PoolState p;
+            p.pool_id = r.take(32);
+            need(r.u32() == 0, "pool body type");
+            p.assetA = read_asset(r);
+            p.assetB = read_asset(r);
+            p.fee = r.i32();
+            p.reserveA = r.i64();
+            p.reserveB = r.i64();
+            p.totalPoolShares = r.i64();
+            p.poolSharesTrustLineCount = r.i64();
+            need(r.u32() == 0, "entry sponsored");
+            need(r.done(), "trailing entry bytes");
+            e.kind = K_POOL;
+            e.pool = p;
         } else {
             e.kind = K_OTHER;
             return; /* carried verbatim; touching it declines */
@@ -1125,12 +1188,14 @@ static void payment_result(Wr &w) {
 /* ------------------------------------------------ manage_sell_offer */
 
 struct Atom {
-    std::string seller; /* raw 32 */
-    int64_t offer_id;
+    bool is_pool = false;  /* liquidity-pool atom: pool_id set, no seller */
+    std::string pool_id;   /* raw 32 (pool atoms) */
+    std::string seller;    /* raw 32 (order-book atoms) */
+    int64_t offer_id = 0;
     std::string asset_sold;
-    int64_t amount_sold;
+    int64_t amount_sold = 0;
     std::string asset_bought;
-    int64_t amount_bought;
+    int64_t amount_bought = 0;
 };
 
 static bool crosses(int32_t book_n, int32_t book_d, int32_t own_n,
@@ -1147,6 +1212,15 @@ static bool crosses(int32_t book_n, int32_t book_d, int32_t own_n,
 static void emit_claim_atoms(Wr &w, const std::vector<Atom> &atoms) {
     w.u32((uint32_t)atoms.size());
     for (const Atom &at : atoms) {
+        if (at.is_pool) {
+            w.u32(2); /* CLAIM_ATOM_TYPE_LIQUIDITY_POOL */
+            w.raw(at.pool_id);
+            w.raw(at.asset_sold);
+            w.i64(at.amount_sold);
+            w.raw(at.asset_bought);
+            w.i64(at.amount_bought);
+            continue;
+        }
         w.u32(1); /* CLAIM_ATOM_TYPE_ORDER_BOOK */
         w.u32(0); /* sellerID pk disc */
         w.raw(at.seller);
@@ -1487,13 +1561,172 @@ static void change_trust_result(Wr &w) {
 
 /* ---------------------------------------------------- path payments */
 
-static void check_hop_pool_absent(Ctx &c, const Hop &hop) {
-    /* pool quoting (convert_with_offers_and_pools) stays host-side: a
-     * LIVE pool on the pair can win the route, so the kernel declines
-     * and the Python reference adjudicates.  The pool key rides the
-     * footprint's book materialization, so it is always declared. */
+/* Constant-product quote twins (transactions/liquidity_pool.py).  The
+ * Python reference computes in unbounded ints; the kernel works in
+ * i128, and any product that could exceed it DECLINES so the bignum
+ * reference adjudicates — it never wraps. */
+static const i128 I128_MAX = (i128)(((unsigned __int128)1 << 127) - 1);
+
+/* floor((f*rout*in) / (10000*rin + f*in)), f = 10000 - fee_bps; false
+ * mirrors the reference returning None (caller falls back to the book) */
+static bool pool_swap_out_given_in(int64_t rin, int64_t rout, int64_t in,
+                                   int32_t fee_bps, int64_t *out) {
+    if (in <= 0 || rin <= 0 || rout <= 0)
+        return false;
+    if (in > INT64_MAX_ - rin)
+        return false;
+    i128 f = POOL_MAX_BPS - fee_bps;
+    i128 prod = f * (i128)rout;
+    need(prod == 0 || (i128)in <= I128_MAX / prod, "pool math overflow");
+    i128 num = prod * (i128)in;
+    i128 den = (i128)POOL_MAX_BPS * rin + f * (i128)in;
+    i128 o = num / den; /* non-negative operands: trunc == floor */
+    if (o == 0)
+        return false;
+    *out = (int64_t)o; /* o < rout, so it fits */
+    return true;
+}
+
+/* ceil((10000*rin*out) / ((rout-out)*f)); false mirrors None */
+static bool pool_swap_in_given_out(int64_t rin, int64_t rout, int64_t outv,
+                                   int32_t fee_bps, int64_t *in) {
+    if (outv <= 0 || rin <= 0 || rout <= 0)
+        return false;
+    if (outv >= rout)
+        return false;
+    i128 f = POOL_MAX_BPS - fee_bps;
+    i128 a = (i128)POOL_MAX_BPS * rin;
+    need((i128)outv <= I128_MAX / a, "pool math overflow");
+    i128 num = a * (i128)outv;
+    i128 den = ((i128)rout - outv) * f; /* > 0 */
+    need(num <= I128_MAX - den, "pool math overflow");
+    i128 amt = (num + den - 1) / den; /* ceil */
+    if (amt > (i128)INT64_MAX_ - rin)
+        return false;
+    *in = (int64_t)amt;
+    return true;
+}
+
+/* convert_with_offers_and_pools (offer_exchange.py): quote the hop's
+ * declared pool, attempt the book in a child frame, keep whichever
+ * side wins — the book only on a strictly better price.  The pool key
+ * rides the footprint's book materialization, so it is always
+ * declared; an absent pool degrades to the plain book crossing. */
+static ConvertOut convert_hop(Ctx &c, const std::string &src,
+                              const Hop &hop, int64_t max_sheep_send,
+                              int64_t max_wheat_receive, int round_) {
+    const std::string &sheep = hop.selling, &wheat = hop.buying;
     Entry *pe = declared(c, hop.pool_key);
-    need(!pe->exists, "liquidity pool on hop");
+    bool have_quote = false;
+    bool sheep_is_a = false;
+    int64_t to_pool = 0, from_pool = 0;
+    if (pe->exists) {
+        need(pe->kind == K_POOL && pe->supported,
+             "unsupported pool shape");
+        const PoolState &p = pe->pool;
+        /* compare_assets' total order equals lexicographic order of the
+         * canonical asset encodings, so byte compare decides A/B */
+        sheep_is_a = sheep < wheat;
+        const std::string &ca = sheep_is_a ? sheep : wheat;
+        const std::string &cb = sheep_is_a ? wheat : sheep;
+        /* the footprint derived this key from (min, max, fee=30); an
+         * entry disagreeing with its own key is outside the model */
+        need(p.assetA == ca && p.assetB == cb && p.fee == POOL_FEE_V18,
+             "pool params mismatch");
+        int64_t rin = sheep_is_a ? p.reserveA : p.reserveB;
+        int64_t rout = sheep_is_a ? p.reserveB : p.reserveA;
+        if (rin > 0 && rout > 0) {
+            if (round_ == ROUND_PP_STRICT_SEND) {
+                to_pool = max_sheep_send;
+                have_quote = pool_swap_out_given_in(rin, rout, to_pool,
+                                                    p.fee, &from_pool);
+            } else if (round_ == ROUND_PP_STRICT_RECEIVE) {
+                from_pool = max_wheat_receive;
+                have_quote = pool_swap_in_given_out(rin, rout, from_pool,
+                                                    p.fee, &to_pool);
+            }
+        }
+    }
+    if (!have_quote)
+        return convert_with_offers(c, src, sheep, max_sheep_send, wheat,
+                                   max_wheat_receive, round_, 0, 0);
+
+    /* EMPTY book: convert_with_offers would cross nothing (both limits
+     * stay slack -> ConvertResult.PARTIAL -> book loses), so the child
+     * frame is provably a no-op.  Skip the whole-store snapshot — it is
+     * O(cluster) per hop, and a pool-only workload collapses to ONE
+     * conflict cluster, so snapshotting would make the close O(n^2).
+     * best_offer is a pure read (the store is fully pre-materialized). */
+    std::string probe_key;
+    if (best_offer(c, wheat, sheep, &probe_key) == nullptr) {
+        Entry *pe2 = declared(c, hop.pool_key);
+        mark_put(c, *pe2, hop.pool_key);
+        PoolState &p = pe2->pool;
+        if (sheep_is_a) {
+            p.reserveA += to_pool;
+            p.reserveB -= from_pool;
+        } else {
+            p.reserveB += to_pool;
+            p.reserveA -= from_pool;
+        }
+        Atom at;
+        at.is_pool = true;
+        at.pool_id = p.pool_id;
+        at.asset_sold = wheat;
+        at.amount_sold = from_pool;
+        at.asset_bought = sheep;
+        at.amount_bought = to_pool;
+        ConvertOut out;
+        out.sheep_sent = to_pool;
+        out.wheat_received = from_pool;
+        out.atoms.push_back(at);
+        return out;
+    }
+
+    /* book attempt in a child frame (the reference's child LedgerTxn):
+     * snapshot the mutable tx-visible state, roll back if the pool wins */
+    std::map<std::string, Entry> store_snap = c.store;
+    std::map<std::string, std::pair<bool, std::string>> touched_snap =
+        c.op_touched;
+    int64_t idpool_snap = c.idpool;
+    ConvertOut cv = convert_with_offers(c, src, sheep, max_sheep_send,
+                                        wheat, max_wheat_receive, round_,
+                                        0, 0);
+    /* ConvertResult.OK unless BOTH limits kept slack (PARTIAL) */
+    bool book_ok = !(max_wheat_receive - cv.wheat_received > 0 &&
+                     max_sheep_send - cv.sheep_sent > 0);
+    bool use_book =
+        book_ok && (i128)to_pool * cv.wheat_received >
+                       (i128)from_pool * cv.sheep_sent;
+    if (use_book)
+        return cv;
+
+    /* pool wins: restore, then trade against the pool */
+    c.store = std::move(store_snap);
+    c.op_touched = std::move(touched_snap);
+    c.idpool = idpool_snap;
+    Entry *pe2 = declared(c, hop.pool_key); /* re-locate after restore */
+    mark_put(c, *pe2, hop.pool_key);
+    PoolState &p = pe2->pool;
+    if (sheep_is_a) {
+        p.reserveA += to_pool;
+        p.reserveB -= from_pool;
+    } else {
+        p.reserveB += to_pool;
+        p.reserveA -= from_pool;
+    }
+    Atom at;
+    at.is_pool = true;
+    at.pool_id = p.pool_id;
+    at.asset_sold = wheat;
+    at.amount_sold = from_pool;
+    at.asset_bought = sheep;
+    at.amount_bought = to_pool;
+    ConvertOut out;
+    out.sheep_sent = to_pool;
+    out.wheat_received = from_pool;
+    out.atoms.push_back(at);
+    return out;
 }
 
 static void apply_path_payment(Ctx &c, const Tx &tx, Wr &result) {
@@ -1532,10 +1765,9 @@ static void apply_path_payment(Ctx &c, const Tx &tx, Wr &result) {
         int64_t have = tx.amount;
         for (size_t i = 0; i < tx.hops.size(); i++) {
             const Hop &hop = tx.hops[i];
-            check_hop_pool_absent(c, hop);
-            ConvertOut out = convert_with_offers(
-                c, tx.src, hop.selling, have, hop.buying, INT64_MAX_,
-                ROUND_PP_STRICT_SEND, 0, 0);
+            ConvertOut out = convert_hop(c, tx.src, hop, have,
+                                         INT64_MAX_,
+                                         ROUND_PP_STRICT_SEND);
             need(out.sheep_sent >= have, "too few offers");
             atoms.insert(atoms.end(), out.atoms.begin(),
                          out.atoms.end());
@@ -1549,10 +1781,9 @@ static void apply_path_payment(Ctx &c, const Tx &tx, Wr &result) {
         int64_t needed = tx.amount2;
         for (size_t i = tx.hops.size(); i-- > 0;) {
             const Hop &hop = tx.hops[i];
-            check_hop_pool_absent(c, hop);
-            ConvertOut out = convert_with_offers(
-                c, tx.src, hop.selling, INT64_MAX_, hop.buying, needed,
-                ROUND_PP_STRICT_RECEIVE, 0, 0);
+            ConvertOut out = convert_hop(c, tx.src, hop, INT64_MAX_,
+                                         needed,
+                                         ROUND_PP_STRICT_RECEIVE);
             need(out.wheat_received >= needed, "too few offers");
             atoms.insert(atoms.begin(), out.atoms.begin(),
                          out.atoms.end());
@@ -1930,10 +2161,158 @@ static PyObject *apply_cluster(PyObject *self, PyObject *args) {
                          (long long)c.idpool);
 }
 
+/* charge_fees(params, accounts, txs): the whole fee/seqnum phase as
+ * one GIL-released batch (frame.process_fee_seq_num's success path).
+ *   params   = (ledger_seq, base_fee)
+ *   accounts = [entry_bytes, ...] distinct fee sources, first-appearance
+ *              order (every one must exist — the host screens absence)
+ *   txs      = [(acct_idx, full_fee, num_ops), ...] in apply order
+ * -> (True, [(charged, state_bytes, updated_bytes)...],
+ *     [final_entry_bytes...], fee_pool_delta)
+ *  | (False, reason)
+ * The per-tx change pair mirrors LedgerTxn.changes(): STATE carries the
+ * RUNNING pre-image (a repeat source sees the prior tx's post-image,
+ * lastModified already restamped), UPDATED the post-charge image. */
+static PyObject *charge_fees(PyObject *self, PyObject *args) {
+    PyObject *params, *accounts, *txs;
+    if (!PyArg_ParseTuple(args, "OOO", &params, &accounts, &txs))
+        return NULL;
+    long long ls, bf;
+    if (!PyArg_ParseTuple(params, "LL", &ls, &bf))
+        return NULL;
+
+    std::vector<Entry> accts;
+    PyObject *seq = PySequence_Fast(accounts, "accounts must be a sequence");
+    if (!seq)
+        return NULL;
+    accts.resize((size_t)PySequence_Fast_GET_SIZE(seq));
+    for (Py_ssize_t i = 0; i < PySequence_Fast_GET_SIZE(seq); i++) {
+        if (parse_bytes(PySequence_Fast_GET_ITEM(seq, i),
+                        accts[(size_t)i].raw, "fee account bytes") < 0) {
+            Py_DECREF(seq);
+            return NULL;
+        }
+        accts[(size_t)i].exists = true;
+    }
+    Py_DECREF(seq);
+
+    struct FeeTx {
+        long acct;
+        int64_t full_fee;
+        long num_ops;
+    };
+    std::vector<FeeTx> fts;
+    seq = PySequence_Fast(txs, "fee txs must be a sequence");
+    if (!seq)
+        return NULL;
+    for (Py_ssize_t i = 0; i < PySequence_Fast_GET_SIZE(seq); i++) {
+        PyObject *it = PySequence_Fast_GET_ITEM(seq, i);
+        FeeTx ft;
+        ft.acct = PyLong_AsLong(PyTuple_GetItem(it, 0));
+        ft.full_fee = PyLong_AsLongLong(PyTuple_GetItem(it, 1));
+        ft.num_ops = PyLong_AsLong(PyTuple_GetItem(it, 2));
+        if (PyErr_Occurred()) {
+            Py_DECREF(seq);
+            return NULL;
+        }
+        fts.push_back(ft);
+    }
+    Py_DECREF(seq);
+
+    bool declined = false;
+    std::string decline_reason;
+    std::vector<int64_t> charged(fts.size(), 0);
+    std::vector<std::string> state_b(fts.size()), upd_b(fts.size());
+    std::vector<std::string> final_b(accts.size());
+    int64_t fee_pool = 0;
+
+    Py_BEGIN_ALLOW_THREADS;
+    try {
+        for (auto &e : accts) {
+            parse_entry(e);
+            need(e.kind == K_ACCT && e.supported,
+                 "unsupported account shape");
+        }
+        for (size_t i = 0; i < fts.size(); i++) {
+            FeeTx &ft = fts[i];
+            need(ft.acct >= 0 && (size_t)ft.acct < accts.size(),
+                 "fee account index out of range");
+            Entry &e = accts[(size_t)ft.acct];
+            /* fee = min(full_fee, base_fee * max(1, num_ops)); the
+             * product is bounded in i128 and min() with an int64 */
+            i128 per_ops = (i128)bf * (ft.num_ops > FEE_OPS_FLOOR
+                                           ? ft.num_ops
+                                           : FEE_OPS_FLOOR);
+            i128 fee = (i128)ft.full_fee < per_ops ? (i128)ft.full_fee
+                                                   : per_ops;
+            int64_t ch = (int64_t)(fee < (i128)e.acct.balance
+                                       ? fee
+                                       : (i128)e.acct.balance);
+            Wr st;
+            st.u32(CH_STATE);
+            st.raw(encode_entry(e));
+            state_b[i] = st.out;
+            e.acct.balance -= ch;
+            e.lastModified = (uint32_t)ls;
+            Wr up;
+            up.u32(CH_UPDATED);
+            up.raw(encode_entry(e));
+            upd_b[i] = up.out;
+            charged[i] = ch;
+            need(fee_pool <= INT64_MAX_ - ch, "fee pool overflow");
+            fee_pool += ch;
+        }
+        for (size_t i = 0; i < accts.size(); i++)
+            final_b[i] = encode_entry(accts[i]);
+    } catch (Decline &d) {
+        declined = true;
+        decline_reason = d.reason;
+    }
+    Py_END_ALLOW_THREADS;
+
+    if (declined)
+        return Py_BuildValue("(Os)", Py_False, decline_reason.c_str());
+
+    PyObject *rows = PyList_New((Py_ssize_t)fts.size());
+    if (!rows)
+        return NULL;
+    for (size_t i = 0; i < fts.size(); i++) {
+        PyObject *tup = Py_BuildValue(
+            "(Ly#y#)", (long long)charged[i], state_b[i].data(),
+            (Py_ssize_t)state_b[i].size(), upd_b[i].data(),
+            (Py_ssize_t)upd_b[i].size());
+        if (!tup) {
+            Py_DECREF(rows);
+            return NULL;
+        }
+        PyList_SET_ITEM(rows, (Py_ssize_t)i, tup);
+    }
+    PyObject *finals = PyList_New((Py_ssize_t)accts.size());
+    if (!finals) {
+        Py_DECREF(rows);
+        return NULL;
+    }
+    for (size_t i = 0; i < accts.size(); i++) {
+        PyObject *b = PyBytes_FromStringAndSize(
+            final_b[i].data(), (Py_ssize_t)final_b[i].size());
+        if (!b) {
+            Py_DECREF(rows);
+            Py_DECREF(finals);
+            return NULL;
+        }
+        PyList_SET_ITEM(finals, (Py_ssize_t)i, b);
+    }
+    return Py_BuildValue("(ONNL)", Py_True, rows, finals,
+                         (long long)fee_pool);
+}
+
 static PyMethodDef Methods[] = {
     {"apply_cluster", apply_cluster, METH_VARARGS,
      "Apply one kernel-eligible cluster strip GIL-free; returns "
      "(True, deltas, records, idpool) or (False, reason, tx_index)."},
+    {"charge_fees", charge_fees, METH_VARARGS,
+     "Charge the whole fee phase GIL-free; returns (True, rows, "
+     "finals, fee_pool_delta) or (False, reason)."},
     {NULL, NULL, 0, NULL},
 };
 

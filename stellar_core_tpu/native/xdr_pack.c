@@ -573,11 +573,102 @@ py_pack(PyObject *self, PyObject *args)
     return res;
 }
 
+/* pack_many(items): one C call for a whole batch of encodes.
+ * items = sequence of (type_index, value); returns [bytes, ...].
+ * Every value is packed into one shared arena first (the object walk
+ * needs the interpreter, but memoized structs/unions resolve to a
+ * single lookup + arena append), then the per-row copy-out into the
+ * preallocated bytes objects runs with the GIL RELEASED — on the
+ * pipelined tail worker that is the window the next close's fee/apply
+ * phases reclaim. */
+static PyObject *
+py_pack_many(PyObject *self, PyObject *args)
+{
+    PyObject *items;
+    if (!PyArg_ParseTuple(args, "O", &items))
+        return NULL;
+    if (!g_nodes) {
+        PyErr_SetString(PyExc_RuntimeError, "schema not initialized");
+        return NULL;
+    }
+    PyObject *seq = PySequence_Fast(items, "pack_many expects a sequence");
+    if (!seq)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+
+    Out o = {NULL, 0, 0};
+    size_t *offs = (size_t *)PyMem_Malloc(sizeof(size_t) * (size_t)(n + 1));
+    if (!offs) {
+        Py_DECREF(seq);
+        return PyErr_NoMemory();
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *it = PySequence_Fast_GET_ITEM(seq, i);
+        PyObject *io = PyTuple_GetItem(it, 0);
+        PyObject *v = PyTuple_GetItem(it, 1);
+        Py_ssize_t idx = io ? PyLong_AsSsize_t(io) : -1;
+        if (!io || !v || (idx == -1 && PyErr_Occurred()) ||
+            idx < 0 || idx >= g_count) {
+            if (!PyErr_Occurred())
+                PyErr_SetString(PyExc_RuntimeError,
+                                "pack_many: bad (index, value) item");
+            goto fail;
+        }
+        offs[i] = o.len;
+        if (pack_node((int32_t)idx, v, &o) < 0)
+            goto fail;
+    }
+    offs[n] = o.len;
+
+    {
+        /* snapshot every destination buffer pointer WITH the GIL held;
+         * the GIL-released region below touches only raw memory */
+        char **dsts = (char **)PyMem_Malloc(sizeof(char *) * (size_t)n);
+        PyObject *res = PyList_New(n);
+        if (!res || !dsts) {
+            Py_XDECREF(res);
+            PyMem_Free(dsts);
+            if (!PyErr_Occurred())
+                PyErr_NoMemory();
+            goto fail;
+        }
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject *b = PyBytes_FromStringAndSize(
+                NULL, (Py_ssize_t)(offs[i + 1] - offs[i]));
+            if (!b) {
+                Py_DECREF(res);
+                PyMem_Free(dsts);
+                goto fail;
+            }
+            dsts[i] = PyBytes_AS_STRING(b);
+            PyList_SET_ITEM(res, i, b);
+        }
+        Py_BEGIN_ALLOW_THREADS;
+        for (Py_ssize_t i = 0; i < n; i++)
+            memcpy(dsts[i], o.buf + offs[i], offs[i + 1] - offs[i]);
+        Py_END_ALLOW_THREADS;
+        PyMem_Free(dsts);
+        PyMem_Free(offs);
+        PyMem_Free(o.buf);
+        Py_DECREF(seq);
+        return res;
+    }
+
+fail:
+    PyMem_Free(offs);
+    PyMem_Free(o.buf);
+    Py_DECREF(seq);
+    return NULL;
+}
+
 static PyMethodDef methods[] = {
     {"init_schema", py_init_schema, METH_VARARGS,
      "Install the compiled node table (one-shot)."},
     {"pack", py_pack, METH_VARARGS,
      "pack(type_index, value) -> canonical XDR bytes."},
+    {"pack_many", py_pack_many, METH_VARARGS,
+     "pack_many([(type_index, value), ...]) -> [bytes, ...] in one "
+     "native call (copy-out phase GIL-released)."},
     {NULL, NULL, 0, NULL},
 };
 

@@ -33,9 +33,11 @@ class LoadGenerator:
         self._rate_timer = None
         self._rate_state: Optional[dict] = None
         # payment destination graph: "ring" (i pays i+1; one conflict
-        # component), "pairs" (2j <-> 2j+1; disjoint account pairs), or
+        # component), "pairs" (2j <-> 2j+1; disjoint account pairs),
         # "credit" (pairs graph, but payments move the LOAD credit
-        # asset over trustlines — setup_dex() first)
+        # asset over trustlines — setup_dex() first), or "pool" (pairs
+        # graph, path payments routed through LIVE constant-product
+        # pools — setup_pool() first)
         self.payment_pattern = "ring"
 
     # -- deterministic account derivation -----------------------------------
@@ -150,6 +152,10 @@ class LoadGenerator:
         tracked per source)."""
         accts = accounts or self.accounts
         assert accts, "CREATE accounts first"
+        if self.payment_pattern == "pool":
+            assert getattr(self, "pool_ids", None), \
+                "setup_pool() first for payment_pattern='pool'"
+            return self.generate_pool_payments(n, accounts=accounts)
         asset = None
         if self.payment_pattern == "credit":
             assert getattr(self, "dex_asset", None) is not None, \
@@ -571,6 +577,92 @@ class LoadGenerator:
             dest = accts[p].public_key().raw
             out.append(self.path_payment_envelope(
                 src, dest, 1 + (i % 500), strict_send=(i % 2 == 0)))
+        return out
+
+    # -- POOL mode (path payments through LIVE liquidity pools) -------------
+
+    def setup_pool(self, hops: int = 2, reserve: int = 10**12) -> None:
+        """Seed ``hops``-hop LIVE constant-product pools: one pool per
+        chain hop pair (native<->PATHA [, PATHA<->PATHB ...]) with deep
+        equal reserves, plus final-asset trustlines for every generator
+        account (the recipients).  NO maker books: the pools are the
+        only venue on each hop, so every path payment crosses them (the
+        empty book walk loses the book-vs-pool arbitration and the
+        constant-product quote adjudicates).  Bulk-seeded perf-rig
+        style like setup_path; flips ``payment_pattern`` to "pool"."""
+        from ..transactions import liquidity_pool as LP
+
+        assert self.accounts, "CREATE accounts first"
+        issuers, assets, _ = self._derive_path(hops, makers=0)
+        root = self.app.ledger_manager.root
+        pool_ids = []
+        cp_type = T.LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT
+        with LedgerTxn(root) as ltx:
+            for sk in issuers:
+                if ltx.load_account(sk.public_key().raw) is None:
+                    ltx.put(U.make_account_entry(
+                        sk.public_key().raw, 10**9, seq_num=0))
+            final = assets[-1]
+            for sk in self.accounts:
+                pub = sk.public_key().raw
+                if ltx.load_trustline(pub, final) is None:
+                    ltx.put(U.make_trustline_entry(
+                        pub, final, balance=0, limit=U.INT64_MAX))
+                    e = ltx.load_account(pub)
+                    acc = e.data.value
+                    ltx.put(e._replace(data=T.LedgerEntryData.make(
+                        T.LedgerEntryType.ACCOUNT,
+                        acc._replace(
+                            numSubEntries=acc.numSubEntries + 1))))
+            chain = [U.asset_native(), *assets]
+            for x, y in zip(chain, chain[1:]):
+                a, b = ((x, y) if LP.compare_assets(x, y) < 0
+                        else (y, x))
+                params = T.LiquidityPoolParameters.make(
+                    cp_type,
+                    T.LiquidityPoolConstantProductParameters.make(
+                        assetA=a, assetB=b,
+                        fee=T.LIQUIDITY_POOL_FEE_V18))
+                pool_id = LP.pool_id_from_params(params)
+                cp = T.LiquidityPoolEntry.fields[1][1].arms[
+                    cp_type][1].make(
+                    params=params.value, reserveA=reserve,
+                    reserveB=reserve, totalPoolShares=reserve,
+                    poolSharesTrustLineCount=1)
+                lp = T.LiquidityPoolEntry.make(
+                    liquidityPoolID=pool_id,
+                    body=T.LiquidityPoolEntry.fields[1][1].make(
+                        cp_type, cp))
+                ltx.put(U.wrap_entry(
+                    T.LedgerEntryType.LIQUIDITY_POOL, lp))
+                pool_ids.append(pool_id)
+            ltx.commit()
+        self.pool_ids = pool_ids
+        self.payment_pattern = "pool"
+
+    def generate_pool_payments(self, n: int,
+                               accounts: Optional[List[SecretKey]] = None
+                               ) -> List:
+        """n path payments routed through the seeded pools — the same
+        alternating strict-send / strict-receive mix as the book
+        workload, but amounts start at 10: the 30bps constant-product
+        fee must never round a hop's output to zero (a zero-output
+        quote is a FAILED path payment, which the success-only kernel
+        declines — poisoning its whole cluster off the fast path)."""
+        accts = accounts or self.accounts
+        assert accts, "CREATE accounts first"
+        assert getattr(self, "pool_ids", None), "setup_pool() first"
+        out = []
+        k = len(accts)
+        for i in range(n):
+            src = accts[i % k]
+            j = i % k
+            p = j ^ 1
+            if p >= k:
+                p = j
+            dest = accts[p].public_key().raw
+            out.append(self.path_payment_envelope(
+                src, dest, 10 + (i % 500), strict_send=(i % 2 == 0)))
         return out
 
     # -- RATE mode (timer-driven tx/s; ref LoadGenerator.h:28-36) -----------

@@ -49,9 +49,11 @@ from collections import deque
 from time import perf_counter
 from typing import Dict, Iterable, List, Optional
 
-#: lifecycle stages in pipeline order
-STAGES = ("recv", "admit", "txset", "nominate", "externalize", "apply",
-          "commit")
+#: lifecycle stages in pipeline order ("fee" = the close's fee/seqnum
+#: charge phase — stamped per tx whether the batched fee kernel or the
+#: per-tx reference loop charged it, so batching keeps attribution)
+STAGES = ("recv", "admit", "txset", "nominate", "externalize", "fee",
+          "apply", "commit")
 _STAGE_INDEX = {s: i for i, s in enumerate(STAGES)}
 #: precomputed histogram names for every ordered stage pair — string
 #: building per completed tx was the dominant rollup cost

@@ -570,6 +570,7 @@ class Lazy(XdrType):
 # -- native encoder wiring (see native/xdr_pack.c) ---------------------------
 
 _native_pack = None
+_native_pack_many = None
 
 
 def _compile_native_schema(roots, build: bool = True) -> None:
@@ -577,7 +578,7 @@ def _compile_native_schema(roots, build: bool = True) -> None:
     it.  Each compiled type gets ``_nidx`` (its node index); ``encode``
     then routes through the C packer.  Wire bytes are identical by
     construction; the Python pack tree remains the fallback/oracle."""
-    global _native_pack
+    global _native_pack, _native_pack_many
     from ..native import get_xdrpack
 
     mod = get_xdrpack(build=build)
@@ -660,7 +661,25 @@ def _compile_native_schema(roots, build: bool = True) -> None:
     for idx, t in index.values():
         t._nidx = idx
     _native_pack = mod.pack
+    # older prebuilt .so without the batch entry: encode_many degrades
+    _native_pack_many = getattr(mod, "pack_many", None)
 
+
+def encode_many(pairs):
+    """Batch encode ``[(XdrType, value), ...]`` -> ``[bytes, ...]`` in
+    ONE native call (xdr_pack.c pack_many: shared arena, GIL-released
+    copy-out), or None when the native packer is unavailable — callers
+    fall back to per-value ``encode``.  Bytes are identical either way
+    (same node table, same packer)."""
+    if _native_pack_many is None:
+        return None
+    items = []
+    for t, v in pairs:
+        idx = getattr(t, "_nidx", -1)
+        if idx < 0:
+            return None
+        items.append((idx, v))
+    return _native_pack_many(items)
 
 
 def _encode_native(self, v):

@@ -1597,6 +1597,9 @@ LedgerCloseMeta = Union("LedgerCloseMeta", Int, {
 # row, ledger-close meta stream) — cache the first encoding on the value
 TransactionResultPair.memoize = True
 TransactionMeta.memoize = True
+# the batched fee kernel returns feeProcessing changes pre-encoded; the
+# memo slot lets LazyUnion carry those bytes straight into the meta
+LedgerEntryChange.memoize = True
 
 # route encode() through the native schema-VM packer when the toolchain
 # can build it (native/xdr_pack.c); wire-identical, Python pack remains

@@ -578,6 +578,46 @@ def test_issue13_kernel_constant_drift_is_caught():
             [f.render() for f in hits]
 
 
+def test_issue16_kernel_constant_drift_is_caught():
+    """The ISSUE-16 constants (pool constant-product fee/rounding, the
+    fee phase's op floor, seqnum account-ext tags, pool XDR tags) are
+    lockstep-pinned: a one-character C++ edit on any of them is red."""
+    for frm, to, name in (
+            ("POOL_FEE_V18 = 30", "POOL_FEE_V18 = 31", "pool-fee-v18"),
+            ("POOL_MAX_BPS = 10000", "POOL_MAX_BPS = 10001",
+             "pool-max-bps"),
+            ("FEE_OPS_FLOOR = 1", "FEE_OPS_FLOOR = 0", "fee-ops-floor"),
+            ("ACC_EXT_V3 = 3", "ACC_EXT_V3 = 4", "account-v2-ext-v3-tag"),
+            ("LE_LIQUIDITY_POOL = 5", "LE_LIQUIDITY_POOL = 6",
+             "le-liquidity-pool"),
+            ("w.u32(2); /* CLAIM_ATOM_TYPE_LIQUIDITY_POOL",
+             "w.u32(3); /* CLAIM_ATOM_TYPE_LIQUIDITY_POOL",
+             "claim-atom-liquidity-pool")):
+        drifted = _kernel_source().replace(frm, to)
+        assert drifted != _kernel_source(), frm
+        hits = [f for f in lint_sources({KERNEL: drifted})
+                if f.rule == "native-lockstep"]
+        assert hits, f"{name}: drift must fail the gate"
+        assert any(name in f.message for f in hits), \
+            [f.render() for f in hits]
+
+
+def test_issue16_python_pool_rounding_drift_is_caught():
+    """The pool math's Python twin (liquidity_pool.py's basis-point
+    denominator) is pinned too — the kernel quote must divide by the
+    very same constant."""
+    path = "stellar_core_tpu/transactions/liquidity_pool.py"
+    with open(f"{REPO}/{path}", encoding="utf-8") as fh:
+        src = fh.read()
+    drifted = src.replace("f = 10000 - fee_bps", "f = 10001 - fee_bps")
+    assert drifted != src
+    findings = [f for f in lint_sources({path: drifted})
+                if f.rule == "native-lockstep"]
+    assert findings, "python-side pool drift must fail the gate"
+    assert any("pool-max-bps" in f.message and f.file == path
+               for f in findings), [f.render() for f in findings]
+
+
 def test_python_side_constant_drift_is_caught():
     """The same entry fails when the PYTHON twin drifts instead."""
     path = "stellar_core_tpu/transactions/utils.py"

@@ -156,7 +156,7 @@ def test_ineligible_tx_mid_cluster_falls_back_and_matches():
     assert stats["aborts"] == 0, stats
 
 
-def _extra_signer_workload(workers, **kw):
+def _extra_signer_workload(workers, app_hook=None, **kw):
     """State-level decline: an account grows a second signer, so later
     payments from it are kernel-SHAPED but the kernel's account parse
     refuses (signers stay host-side) — decline, Python fallback, same
@@ -164,6 +164,8 @@ def _extra_signer_workload(workers, **kw):
     from stellar_core_tpu.crypto import sha256
 
     app = _mk_app(workers, **kw)
+    if app_hook is not None:
+        app_hook(app)
     lg = LoadGenerator(app)
     lg.payment_pattern = "pairs"
     lg.create_accounts(20)
@@ -326,10 +328,12 @@ def test_three_hop_path_payments_match():
     assert stats["native_hits"] > 0, stats
 
 
-def test_live_pool_on_hop_declines_to_python_and_matches():
-    """A LIVE liquidity pool on a hop pair must decline the kernel
-    (pool quoting stays host-side) and the Python reference must
-    adjudicate — same bytes, decline taxonomy names the guard."""
+def test_live_pool_on_hop_goes_native_and_matches():
+    """A LIVE liquidity pool on a hop pair quotes IN-KERNEL (r16):
+    the constant-product-vs-book arbitration runs inside the crossing
+    loop, bytes identical to the Python reference.  NATIVE_POOL_QUOTE=0
+    is the kill switch — the old decline-if-live-pool screen returns,
+    Python adjudicates, same bytes, taxonomy names the guard."""
     from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
     from stellar_core_tpu.transactions import liquidity_pool as LP
     from stellar_core_tpu.transactions import utils as U
@@ -359,8 +363,8 @@ def test_live_pool_on_hop_declines_to_python_and_matches():
             ltx.put(U.wrap_entry(T.LedgerEntryType.LIQUIDITY_POOL, lp))
             ltx.commit()
 
-    def run(workers, native):
-        app = _mk_app(workers, NATIVE_APPLY=native)
+    def run(workers, native, **kw):
+        app = _mk_app(workers, NATIVE_APPLY=native, **kw)
         lg = LoadGenerator(app)
         lg.create_accounts(12)
         maker_envs = lg.setup_path(hops=2, makers=2)
@@ -380,11 +384,17 @@ def test_live_pool_on_hop_declines_to_python_and_matches():
 
     seq, _ = run(0, False)
     fps, stats = run(2, True)
-    _assert_identical(seq, fps, "pool-on-hop decline")
-    assert stats["native_declines"] > 0, stats
+    _assert_identical(seq, fps, "pool-on-hop native")
+    assert stats["native_hits"] > 0, stats
+    # kill switch: with pool quoting forced off the old host screen
+    # declines the cluster and the Python reference adjudicates —
+    # bytes still identical
+    fps_off, stats_off = run(2, True, NATIVE_POOL_QUOTE=False)
+    _assert_identical(seq, fps_off, "pool-on-hop decline (quote off)")
+    assert stats_off["native_declines"] > 0, stats_off
     assert any("liquidity pool on hop" in r
-               for r in stats["native_decline_reasons"]), \
-        stats["native_decline_reasons"]
+               for r in stats_off["native_decline_reasons"]), \
+        stats_off["native_decline_reasons"]
 
 
 def test_offer_modify_delete_go_native_and_match():

@@ -124,13 +124,16 @@ def test_lifecycle_through_real_closes():
     rec = rep["recent"][-1]
     assert rec["ledger"] == app.ledger_manager.last_closed_seq()
     ms = rec["stages_ms"]
-    # the full self-proposed pipeline, stamps in monotonic order
+    # the full self-proposed pipeline, stamps in monotonic order —
+    # including the r16 "fee" stage (stamped whether the batched fee
+    # kernel or the per-tx reference loop charged the tx)
     for a, b in zip(("admit", "txset", "nominate", "externalize",
-                     "apply", "commit"),
-                    ("txset", "nominate", "externalize", "apply",
-                     "commit", "commit")):
+                     "fee", "apply", "commit"),
+                    ("txset", "nominate", "externalize", "fee",
+                     "apply", "commit", "commit")):
         assert ms[a] <= ms[b], (a, b, ms)
     assert rep["latency"]["txtrace.e2e.admit_to_commit"]["count"] >= 32
+    assert rep["latency"]["txtrace.stage.fee_to_apply"]["count"] >= 32
     app.graceful_stop()
 
 

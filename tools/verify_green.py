@@ -42,7 +42,10 @@ Usage: python tools/verify_green.py            -> exit 0 iff green
            (tools/parallel_apply_bench.py --credit-smoke: credit-mix +
            path-payment closes bit-identical native-vs-Python AND
            native cluster-hit rate >= 0.9 — declines on those mixes
-           are bugs now).
+           are bugs now); --skip-fee-smoke skips the batched fee-phase
+           gate (tools/parallel_apply_bench.py --fee-smoke: NATIVE_FEE
+           on/off closes bit-identical AND the charge_fees batch
+           carries >= 90% of closes on the mixed workload).
 """
 import json
 import os
@@ -290,6 +293,43 @@ def run_credit_native_smoke() -> "tuple":
     return problems, summary or "no shapes reported"
 
 
+def run_fee_native_smoke() -> "tuple":
+    """The ISSUE-16 fee-phase gate: a mixed workload with the batched
+    charge_fees kernel on vs NATIVE_FEE=0 must close bit-identical
+    (hashes AND meta bytes), and the fee batch must carry >= 90% of
+    closes (whole-batch declines on clean traffic are bugs now).
+    Returns (problems, summary)."""
+    out = "/tmp/_t1_fee_smoke.json"
+    cmd = [sys.executable, "-m", "tools.parallel_apply_bench",
+           "--fee-smoke", "--out", out]
+    print(f"verify_green: [fee-native smoke] {' '.join(cmd)}",
+          flush=True)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=900)
+    try:
+        with open(out) as f:
+            rep = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"fee-native smoke report unreadable: {e} "
+                f"(exit {proc.returncode})"], "failed"
+    problems = []
+    if not rep.get("parity_identical"):
+        problems.append(
+            "fee-native smoke: NATIVE_FEE on/off parity DIVERGED")
+    if rep.get("fee_batch_hit_rate", 0.0) < 0.9:
+        problems.append(
+            f"fee-native smoke: fee-batch hit rate "
+            f"{rep.get('fee_batch_hit_rate')} < 0.9 "
+            f"(counters: {rep.get('fee_batch')})")
+    if proc.returncode != 0 and not problems:
+        problems.append(f"fee-native smoke exited {proc.returncode}")
+    summary = (f"hit_rate={rep.get('fee_batch_hit_rate')} "
+               f"parity={'ok' if rep.get('parity_identical') else 'FAILED'}")
+    return problems, summary
+
+
 def run_chaos_smoke() -> "tuple":
     """One small chaos scenario end-to-end (core-4 partition+heal, with
     the same-seed determinism rerun): the full fault-inject -> heal ->
@@ -440,6 +480,7 @@ def main() -> int:
     skip_pipeline = "--skip-pipeline-smoke" in sys.argv
     skip_soak = "--skip-soak-smoke" in sys.argv
     skip_credit = "--skip-credit-smoke" in sys.argv
+    skip_fee = "--skip-fee-smoke" in sys.argv
     skip_forensics = "--skip-forensics-smoke" in sys.argv
     if smoke_only:
         cmd = tier1_command()
@@ -515,6 +556,12 @@ def main() -> int:
               flush=True)
         problems.extend(cr_problems)
         smoke_note += f", credit smoke: {cr_summary}"
+    if not skip_fee:
+        fee_problems, fee_summary = run_fee_native_smoke()
+        print(f"verify_green: fee-native smoke: {fee_summary}",
+              flush=True)
+        problems.extend(fee_problems)
+        smoke_note += f", fee smoke: {fee_summary}"
     if not skip_pipeline:
         pl_problems, pl_passed, pl_summary = run_pipelined_smoke(cmd)
         print(f"verify_green: pipelined-close smoke: {pl_summary}",
