@@ -489,15 +489,22 @@ class Herder:
         checks): below = already closed and purged (a stale replay would
         re-create dead Slot objects forever), above = beyond the
         validity bracket (a far-future flood would grow slot state
-        unboundedly).  The upper bound only applies while TRACKING —
-        like the reference's maxLedgerSeq — because a node that fell
-        far behind must still ingest live traffic to learn how far
-        behind it is and start catching up."""
+        unboundedly).  The upper bound anchors on the newest slot
+        CONSENSUS has externalized (ref nextConsensusLedgerIndex +
+        LEDGER_VALIDITY_BRACKET), not the local LCL: a node catching up
+        keeps its LCL parked at the restore point for minutes while it
+        must keep ingesting (and buffering) live traffic 1000+ slots
+        ahead.  Before the first externalize this session there is no
+        tracked slot to anchor on, so no upper bound applies — a cold
+        node must be able to learn how far behind it is."""
         lcl = self.app.ledger_manager.last_closed_seq()
         lookback = max(SCP_EXTRA_LOOKBACK_LEDGERS,
                        self.app.config.MAX_SLOTS_TO_REMEMBER)
-        hi = (lcl + LEDGER_VALIDITY_BRACKET
-              if self.state == HerderState.TRACKING else 2 ** 63)
+        if (self.state == HerderState.TRACKING
+                and self._tracking_slot is not None):
+            hi = max(lcl, self._tracking_slot) + LEDGER_VALIDITY_BRACKET
+        else:
+            hi = 2 ** 63
         return (max(1, lcl - lookback + 1), hi)
 
     def recv_scp_envelope(self, env) -> EnvelopeState:

@@ -89,6 +89,10 @@ class HistoryArchiveState:
 class HistoryArchive:
     """One archive backed by a local directory."""
 
+    # local-filesystem transfers are safe to run from the scheduler's
+    # worker pool (catchup's parallel downloads)
+    thread_safe = True
+
     def __init__(self, name: str, root: str):
         self.name = name
         self.root = root
@@ -138,6 +142,14 @@ class HistoryArchive:
         raw = self.get_file(category_path("bucket", hash_hex, ".xdr.gz"))
         return gzip.decompress(raw) if raw is not None else None
 
+    def has_bucket(self, hash_hex: str) -> bool:
+        """Cheap existence probe (content-addressed, so presence implies
+        the right bytes); CommandArchive inherits the conservative
+        put-memo has_file."""
+        if hash_hex == "00" * 32:
+            return True
+        return self.has_file(category_path("bucket", hash_hex, ".xdr.gz"))
+
     def put_has(self, has: HistoryArchiveState) -> None:
         name = checkpoint_name(has.current_ledger)
         data = has.to_json().encode()
@@ -172,6 +184,10 @@ class CommandArchive(HistoryArchive):
     completion here: publish/catchup steps treat a transfer as one
     synchronous unit, with subprocess isolation and the operator's
     transport of choice."""
+
+    # transfers poll the main-thread ProcessManager — catchup must not
+    # dispatch them to the worker pool
+    thread_safe = False
 
     def __init__(self, name: str, get_cmd: Optional[str] = None,
                  put_cmd: Optional[str] = None,

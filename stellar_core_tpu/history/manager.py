@@ -36,10 +36,32 @@ class HistoryManager:
                 self.archives.append(HistoryArchive(name, path))
         self.published_checkpoints = 0
         # replay (catchup) closes must not re-publish into the archive
-        # being read — see ApplyCheckpointsWork
-        self.suppress_publish = False
+        # being read — see ApplyCheckpointsWork.  Scoped + depth-counted:
+        # only publish_suppressed() can set it, so an exception mid-
+        # replay can never leave a node that silently never publishes
+        # again (the old bare-flag failure mode)
+        self._suppress_publish_depth = 0
         # buckets referenced by queued-but-unpublished checkpoints
         self._pinned = {}
+
+    @property
+    def suppress_publish(self) -> bool:
+        return self._suppress_publish_depth > 0
+
+    def publish_suppressed(self):
+        """Exception-safe scope in which checkpoint publishing is off
+        (replay/catchup closes).  Reentrant: nested scopes stack."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _guard():
+            self._suppress_publish_depth += 1
+            try:
+                yield
+            finally:
+                self._suppress_publish_depth -= 1
+
+        return _guard()
 
     # -- crash-safe publish queue (persistentstate row; ref the reference
     # persisting its publish queue inside the ledger-commit txn,
@@ -148,6 +170,9 @@ class HistoryManager:
                     break
             if w.state == State.SUCCESS:
                 remaining.remove(entry)
+                self.app.metrics.counter("history.publish.success").inc()
+            else:
+                self.app.metrics.counter("history.publish.failure").inc()
         if remaining != queue:
             self._store_queue(remaining)
         # unpin buckets no longer referenced by any queued checkpoint
@@ -238,6 +263,12 @@ class HistoryManager:
             for hh in pair:
                 if hh == "00" * 32 or hh in bucket_blobs:
                     continue
+                # content-addressed: a bucket every archive already holds
+                # never needs re-serializing (lower levels are stable
+                # across hundreds of checkpoints; at the 1M-entry tier
+                # re-reading them each publish dominates the close path)
+                if all(a.has_bucket(hh) for a in self.archives):
+                    continue
                 data = self._bucket_bytes(hh)
                 if data is None:
                     raise RuntimeError(
@@ -251,7 +282,7 @@ class HistoryManager:
                                b"".join(tx_blob_parts))
             archive.put_xdr_gz("results", name, b"".join(res_blob_parts))
             archive.put_xdr_gz("scp", name, b"".join(scp_parts))
-            for hh, data in bucket_blobs.items():
+            for hh, data in sorted(bucket_blobs.items()):
                 archive.put_bucket(hh, data)
             archive.put_has(has)
         self.published_checkpoints += 1

@@ -76,7 +76,13 @@ class Application:
         from ..apply import ParallelApplyManager
 
         self.parallel_apply = ParallelApplyManager(self)
-        self.work_scheduler = WorkScheduler(clock)
+        from ..work.work import WorkerPool
+
+        self.work_scheduler = WorkScheduler(
+            clock,
+            worker_pool=(WorkerPool(config.WORK_POOL_WORKERS)
+                         if getattr(config, "WORK_POOL_WORKERS", 4) > 0
+                         else None))
         self.herder = Herder(self)
         self.overlay_manager = None   # wired by overlay.setup (optional)
         from ..process import ProcessManager
@@ -304,6 +310,10 @@ class Application:
         # tail; an abandoned tail — the chaos pipeline-window crash —
         # was already discarded via crash_abandon)
         self.ledger_manager.pipeline.shutdown()
+        # abort in-flight works (a mid-catchup teardown re-attaches the
+        # ledger root) and stop the worker pool before the stores they
+        # write to go away below
+        self.work_scheduler.shutdown()
         self.process_manager.shutdown()
         self.parallel_apply.shutdown()
         self.bucket_manager.shutdown()

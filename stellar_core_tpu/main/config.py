@@ -121,6 +121,16 @@ class Config:
         # catchup (ref CATCHUP_COMPLETE: replay every ledger instead of
         # assuming bucket state at the anchor checkpoint)
         self.CATCHUP_COMPLETE: bool = kw.get("CATCHUP_COMPLETE", False)
+        # ledgers behind live before archive catchup triggers
+        self.CATCHUP_TRIGGER_GAP: int = kw.get("CATCHUP_TRIGGER_GAP", 2)
+        # base of the exponential retry backoff (clock-seconds) for
+        # archive download works; 0 = immediate retries
+        self.CATCHUP_RETRY_BACKOFF: float = kw.get(
+            "CATCHUP_RETRY_BACKOFF", 0.1)
+        # worker threads behind the WorkScheduler's pool (parallel
+        # archive fetch/verify; threads spawn lazily, idle nodes pay
+        # nothing); 0 = no pool, every work cranks inline
+        self.WORK_POOL_WORKERS: int = kw.get("WORK_POOL_WORKERS", 4)
 
         # overlay
         self.PEER_PORT: int = kw.get("PEER_PORT", 11625)

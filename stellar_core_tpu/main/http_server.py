@@ -65,6 +65,7 @@ class CommandHandler:
             "trace/summary": self.trace_summary,
             "tx/latency": self.tx_latency,
             "vitals": self.vitals,
+            "catchup-status": self.catchup_status,
         }
 
     def handle(self, path: str, params: Dict[str, str]) -> tuple:
@@ -81,6 +82,9 @@ class CommandHandler:
 
     def info(self, params):
         return 200, {"info": self.app.get_json_info()}
+
+    def catchup_status(self, params):
+        return 200, self.app.catchup_manager.status()
 
     def metrics(self, params):
         # derived metrics registered IN the registry so the Prometheus
@@ -107,6 +111,12 @@ class CommandHandler:
         # (apply.native.fee.decline.<code>) registers on first decline
         m.counter("apply.native.fee.hit")
         m.counter("apply.native.fee.decline")
+        # catchup progress counters pinned from boot (a node that never
+        # fell behind should still scrape zeros, not absences)
+        m.counter("catchup.chain.verified")
+        m.counter("catchup.bucket.downloaded-bytes")
+        m.counter("catchup.bucket.applied-bytes")
+        m.gauge("catchup.buffered-ledgers")
         m.counter("apply.native.tail_encode.hit")
         # bounded per-peer overlay vitals mirrored into the registry
         # (Prometheus rides the registry; the JSON body also carries

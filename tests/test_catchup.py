@@ -1,0 +1,462 @@
+"""Bucket-based fast catchup: the parallel Work-DAG sync subsystem
+(r17 tentpole; ref src/catchup tests + HistoryTests CatchupSimulation).
+
+Covers: work-system backoff/abort/parallelism primitives; minimal vs
+complete mode bit-identity against the live network; corrupted-bucket
+and broken-header-chain rejection; mid-catchup archive failure retried
+with backoff; buffered-live-ledger drain while a (chaos-degraded)
+network keeps closing; and a seed-determinism rerun of the whole
+cold-join scenario."""
+import gzip
+import os
+import threading
+
+import pytest
+
+from stellar_core_tpu.catchup import CatchupConfiguration, CatchupWork
+from stellar_core_tpu.crypto import SecretKey, sha256
+from stellar_core_tpu.history import HistoryArchive, checkpoint_name
+from stellar_core_tpu.history.archive import category_path
+from stellar_core_tpu.simulation.simulation import Simulation
+from stellar_core_tpu.utils.clock import ClockMode, VirtualClock
+from stellar_core_tpu.work.work import (
+    BasicWork, BatchWork, State, ThreadedWork, Work, WorkerPool)
+from stellar_core_tpu.xdr import types as T
+
+from .test_history_catchup import (NodeAccount, close_ledgers_with_traffic,
+                                   make_node)
+
+
+# -- work-system primitives (the parallel-DAG upgrade) -----------------------
+
+
+def test_retry_backoff_waits_for_the_clock():
+    """A failed work with retry_backoff must NOT re-run until the clock
+    passes the (exponential) backoff deadline — no hot-spinning a sick
+    archive."""
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+
+    class Flaky(BasicWork):
+        def __init__(self):
+            super().__init__("flaky", max_retries=3, clock=clock,
+                             retry_backoff=1.0)
+            self.attempts = 0
+
+        def on_run(self):
+            self.attempts += 1
+            return State.SUCCESS if self.attempts == 3 else State.FAILURE
+
+    w = Flaky()
+    w.start()
+    w.crank()
+    assert w.attempts == 1 and w.state == State.RUNNING
+    for _ in range(50):  # cranks without advancing time: no retry
+        w.crank()
+    assert w.attempts == 1
+    clock.set_current_virtual_time(clock.now() + 1.01)
+    w.crank()
+    assert w.attempts == 2  # first backoff (1s) elapsed
+    for _ in range(50):
+        w.crank()
+    # second backoff doubles to 2s: +1.01 is not enough
+    clock.set_current_virtual_time(clock.now() + 1.01)
+    for _ in range(50):
+        w.crank()
+    assert w.attempts == 2
+    clock.set_current_virtual_time(clock.now() + 2.01)
+    w.crank()
+    assert w.attempts == 3 and w.state == State.SUCCESS
+
+
+def test_threaded_batch_actually_overlaps():
+    """BatchWork over ThreadedWork children keeps several on_io calls in
+    flight at once on the pool — the whole point of the parallel DAG."""
+    pool = WorkerPool(max_workers=4)
+    lock = threading.Lock()
+    live = {"cur": 0, "max": 0}
+
+    class Sleeper(ThreadedWork):
+        def on_io(self):
+            import time
+
+            with lock:
+                live["cur"] += 1
+                live["max"] = max(live["max"], live["cur"])
+            time.sleep(0.02)
+            with lock:
+                live["cur"] -= 1
+            return True
+
+    works = [Sleeper(f"s{i}", pool) for i in range(6)]
+    batch = BatchWork("batch", iter(works), batch_size=4)
+    batch.start()
+    for _ in range(10000):
+        if batch.done:
+            break
+        batch.crank()
+    pool.shutdown()
+    assert batch.state == State.SUCCESS
+    assert live["max"] >= 2, f"no overlap: max in flight {live['max']}"
+
+
+def test_abort_propagates_through_the_dag():
+    class Spin(BasicWork):
+        def on_run(self):
+            return State.RUNNING
+
+    class Parent(Work):
+        def do_work(self):
+            return State.SUCCESS
+
+    p = Parent("p", max_retries=0)
+    p.start()
+    kids = [p.add_work(Spin(f"k{i}", max_retries=0)) for i in range(3)]
+    p.crank()
+    p.abort()
+    for _ in range(10):
+        p.crank()
+    assert p.state == State.ABORTED
+    assert all(k.state == State.ABORTED for k in kids)
+
+
+def test_batch_failure_aborts_in_flight_siblings():
+    class Spin(BasicWork):
+        def on_run(self):
+            return State.RUNNING
+
+    class Fail(BasicWork):
+        def on_run(self):
+            return State.FAILURE
+
+    spin = Spin("spin", max_retries=0)
+    fail = Fail("fail", max_retries=0)
+    batch = BatchWork("b", iter([spin, fail]), batch_size=2)
+    batch.start()
+    for _ in range(10):
+        if batch.done:
+            break
+        batch.crank()
+    assert batch.state == State.FAILURE
+    assert spin.state == State.ABORTED  # not orphaned mid-flight
+
+
+# -- live-network cold-join harness ------------------------------------------
+
+
+class SimAccount(NodeAccount):
+    """NodeAccount signing for the simulation's network passphrase."""
+
+    def network_id(self):
+        return self.app.config.network_id()
+
+
+def _settle(sim, rounds=200):
+    for _ in range(rounds):
+        if sim.crank() == 0:
+            break
+
+
+def _publisher_net(arch_dir):
+    """core-2 net (A publishes to the archive)."""
+    sim = Simulation(network_passphrase="catchup test net")
+    seeds = [sha256(b"catchup-sim-%d" % i) for i in range(2)]
+    ids = [SecretKey(s).public_key().raw for s in seeds]
+    qset = {"threshold": 2, "validators": ids}
+    for i, s in enumerate(seeds):
+        kw = {}
+        if i == 0:
+            kw["HISTORY_ARCHIVES"] = [("test", str(arch_dir))]
+        sim.add_node(s, qset, **kw)
+    sim.add_connection(ids[0], ids[1])
+    sim.start_all_nodes()
+    _settle(sim)
+    return sim, ids
+
+
+def _close_net(sim, ids, n, start_name=0):
+    """n consensus rounds on the validators only (a trailing joiner may
+    be mid-catchup), a create-account tx in each odd one."""
+    apps = [sim.nodes[i] for i in ids]
+    app_a = apps[0]
+    for k in range(n):
+        if k % 2 == 1:
+            root = SimAccount(app_a,
+                              SecretKey(app_a.config.network_id()))
+            dest = SecretKey(sha256(b"dest-%d-%d" % (start_name, k)))
+            env = root.tx([root.op_create_account(
+                dest.public_key().raw, 10**9)])
+            assert app_a.herder.recv_transaction(env) == 0
+        target = max(a.ledger_manager.last_closed_seq()
+                     for a in apps) + 1
+        for a in apps:
+            a.herder.trigger_next_ledger()
+        assert sim.crank_until(
+            lambda: all(a.ledger_manager.last_closed_seq() >= target
+                        for a in apps), timeout=60), \
+            f"validators failed to close {target}"
+
+
+def _join_cold(sim, ids, arch_dir, tag, **config_kw):
+    """Add a cold watcher trusting the validators (not in their qsets),
+    wired into the live net with archive access."""
+    seed = sha256(b"catchup-joiner-" + tag)
+    qset = {"threshold": 2, "validators": list(ids)}
+    app = sim.add_node(seed, qset,
+                       HISTORY_ARCHIVES=[("test", str(arch_dir))],
+                       **config_kw)
+    app.start()
+    jid = app.config.node_id()
+    for vid in ids:
+        sim.add_connection(jid, vid)
+    _settle(sim)
+    return app, jid
+
+
+def _converge(sim, joiner, ref_app, ids, timeout=60.0, nudges=24):
+    """Crank until the joiner reaches the reference LCL.  If it misses a
+    close (lossy links) the validators keep closing — a real network
+    does not go quiet, and a small trailing gap only resolves once live
+    closes cross the next checkpoint."""
+    def caught_up():
+        return (joiner.ledger_manager.last_closed_seq() >=
+                ref_app.ledger_manager.last_closed_seq())
+
+    if sim.crank_until(caught_up, timeout=timeout):
+        return
+    for n in range(nudges):
+        _close_net(sim, ids, 1, start_name=1000 + n)
+        if sim.crank_until(caught_up, timeout=timeout):
+            return
+    raise AssertionError(
+        f"joiner stuck at {joiner.ledger_manager.last_closed_seq()} vs "
+        f"{ref_app.ledger_manager.last_closed_seq()}; "
+        f"status={joiner.catchup_manager.status()}")
+
+
+def _cold_join_scenario(tmp_path, joiner_kw, pre=18, live=14,
+                        chaos_drop=0.0):
+    """Publisher net closes ``pre`` ledgers, a cold node joins, the net
+    keeps closing ``live`` more WHILE the joiner catches up.  Returns
+    (sim, joiner app, validator A app, joiner id, validator ids)."""
+    arch_dir = tmp_path / "archive"
+    sim, ids = _publisher_net(arch_dir)
+    _close_net(sim, ids, pre)
+    joiner, jid = _join_cold(
+        sim, ids, arch_dir,
+        b"j-" + str(sorted(joiner_kw.items())).encode(), **joiner_kw)
+    if chaos_drop > 0.0:
+        from stellar_core_tpu.simulation.chaos import ChaosEngine
+
+        chaos = ChaosEngine(sim, seed=7)
+        chaos.set_link(jid, ids[0], drop=chaos_drop)
+    _close_net(sim, ids, live, start_name=1)
+    _converge(sim, joiner, sim.nodes[ids[0]], ids)
+    return sim, joiner, sim.nodes[ids[0]], jid, ids
+
+
+# -- acceptance scenarios ----------------------------------------------------
+
+
+def test_cold_join_minimal_bit_identity(tmp_path):
+    """A cold node trailing past a checkpoint joins the LIVE net via
+    bucket apply + buffered drain and ends bit-identical to the
+    validators (header hash AND bucketListHash, every shared seq)."""
+    sim, joiner, app_a, jid, ids = _cold_join_scenario(
+        tmp_path, joiner_kw={})
+    st = joiner.catchup_manager.status()
+    assert st["runs"] >= 1 and st["failures"] == 0
+    # minimal mode went through the bucket path, not replay-from-genesis
+    assert joiner.metrics.counter(
+        "catchup.bucket.applied-entries").count > 0
+    assert joiner.metrics.counter("catchup.chain.verified").count > 0
+    assert joiner.ledger_manager.last_closed_hash() == \
+        app_a.ledger_manager.last_closed_hash()
+    assert joiner.bucket_manager.get_bucket_list_hash() == \
+        app_a.bucket_manager.get_bucket_list_hash()
+    sim.assert_no_forks([ids[0], ids[1], jid])
+    # and the joiner keeps following the live net afterwards
+    _close_net(sim, ids, 2, start_name=2)
+    _converge(sim, joiner, app_a, ids)
+    sim.assert_no_forks([ids[0], jid])
+
+
+def test_cold_join_complete_mode_matches_minimal(tmp_path):
+    """CATCHUP_COMPLETE replays every ledger instead of assuming buckets
+    — and must land on the exact same state."""
+    sim, joiner, app_a, jid, ids = _cold_join_scenario(
+        tmp_path, joiner_kw={"CATCHUP_COMPLETE": True})
+    st = joiner.catchup_manager.status()
+    assert st["runs"] >= 1
+    # complete mode replayed through close_ledger, no bucket assume
+    assert joiner.metrics.counter("catchup.ledger.replayed").count > 0
+    assert joiner.metrics.counter(
+        "catchup.bucket.applied-entries").count == 0
+    assert joiner.ledger_manager.last_closed_hash() == \
+        app_a.ledger_manager.last_closed_hash()
+    assert joiner.bucket_manager.get_bucket_list_hash() == \
+        app_a.bucket_manager.get_bucket_list_hash()
+    sim.assert_no_forks([ids[0], jid])
+
+
+def test_cold_join_trailing_past_validity_bracket(tmp_path):
+    """Regression: a joiner trailing MORE than LEDGER_VALIDITY_BRACKET
+    ledgers must still ingest live SCP traffic.  The bracket's upper
+    bound anchors on the tracked consensus slot, not the parked LCL —
+    the old lcl-anchored bound silently discarded every live envelope
+    once the trail exceeded 100 ledgers, so the node never buffered
+    anything and catchup never even started (found by the 1M-tier
+    bench, where the joiner trails 1000+)."""
+    from stellar_core_tpu.herder.herder import LEDGER_VALIDITY_BRACKET
+
+    sim, joiner, app_a, jid, ids = _cold_join_scenario(
+        tmp_path, joiner_kw={}, pre=LEDGER_VALIDITY_BRACKET + 10, live=6)
+    assert joiner.metrics.counter("herder.scp.discarded").count == 0
+    assert joiner.catchup_manager.status()["runs"] >= 1
+    assert joiner.ledger_manager.last_closed_hash() == \
+        app_a.ledger_manager.last_closed_hash()
+    assert joiner.bucket_manager.get_bucket_list_hash() == \
+        app_a.bucket_manager.get_bucket_list_hash()
+    sim.assert_no_forks([ids[0], ids[1], jid])
+
+
+def test_buffered_drain_under_lossy_network(tmp_path):
+    """The drain scenario with chaos-engine packet loss on the joiner's
+    link to the publisher: catchup + buffering still converge (retries
+    and the second validator cover the gaps)."""
+    sim, joiner, app_a, jid, ids = _cold_join_scenario(
+        tmp_path, joiner_kw={}, chaos_drop=0.2)
+    assert joiner.catchup_manager.status()["runs"] >= 1
+    assert joiner.ledger_manager.last_closed_hash() == \
+        app_a.ledger_manager.last_closed_hash()
+    sim.assert_no_forks([ids[0], ids[1], jid])
+
+
+def test_seed_determinism_rerun(tmp_path):
+    """The whole cold-join scenario rerun from scratch produces a
+    bit-identical header chain — pool-thread scheduling must never leak
+    into consensus state."""
+    chains = []
+    for run in ("one", "two"):
+        d = tmp_path / run
+        d.mkdir()
+        sim, joiner, app_a, jid, ids = _cold_join_scenario(
+            d, joiner_kw={}, pre=12, live=12)
+        chains.append(sim.header_chain(jid))
+    assert chains[0] == chains[1]
+
+
+# -- rejection + retry paths -------------------------------------------------
+
+
+def _published_archive(tmp_path, n=20):
+    arch_dir = tmp_path / "archive"
+    app = make_node(tmp_path, archive_dir=arch_dir)
+    close_ledgers_with_traffic(app, n)
+    cp = app.history_manager.latest_checkpoint_at_or_before(
+        app.ledger_manager.last_closed_seq())
+    return app, arch_dir, cp
+
+
+def _run_catchup(app, work, max_cranks=4000):
+    app.work_scheduler.schedule(work)
+    for _ in range(max_cranks):
+        # nudge virtual time so clock-based retry backoffs elapse
+        app.clock.set_current_virtual_time(app.clock.now() + 0.01)
+        app.crank(block=False)
+        if work.done:
+            break
+    return work.state
+
+
+def test_corrupted_bucket_rejected(tmp_path):
+    """A bucket whose bytes don't hash to their content address must
+    fail catchup (after retries), leaving the node's state untouched."""
+    app_a, arch_dir, cp = _published_archive(tmp_path)
+    has = HistoryArchive("t", str(arch_dir)).get_checkpoint_has(cp)
+    victim = next(h for h in has.all_bucket_hashes() if h != "00" * 32)
+    path = os.path.join(str(arch_dir),
+                        category_path("bucket", victim, ".xdr.gz"))
+    with open(path, "wb") as f:
+        f.write(gzip.compress(b"\x00garbage\xff" * 64))
+
+    app_b = make_node(tmp_path, archive_dir=arch_dir)
+    work = CatchupWork(app_b, app_b.history_manager.archives[0],
+                       CatchupConfiguration(cp))
+    assert _run_catchup(app_b, work) == State.FAILURE
+    assert app_b.ledger_manager.last_closed_seq() == 1  # untouched
+    # the root still serves reads (not left detached mid-apply)
+    assert app_b.ledger_manager.last_closed_header() is not None
+
+
+def test_broken_header_chain_rejected(tmp_path):
+    """A tampered header file (hash chain broken) must fail verification
+    even though every file downloaded fine."""
+    app_a, arch_dir, cp = _published_archive(tmp_path)
+    arch = HistoryArchive("t", str(arch_dir))
+    blob = arch.get_xdr_gz("ledger", checkpoint_name(cp))
+    from stellar_core_tpu.xdr.runtime import Reader
+
+    r = Reader(blob)
+    entries = []
+    while not r.done():
+        entries.append(T.LedgerHeaderHistoryEntry.unpack(r))
+    # forge the middle entry's close time and restamp ITS hash so the
+    # per-entry check passes — only the chain link can catch it
+    from stellar_core_tpu.xdr import xdr_sha256
+
+    mid = entries[len(entries) // 2]
+    mid.header.scpValue.closeTime += 12345
+    mid.hash = xdr_sha256(T.LedgerHeader, mid.header)
+    forged = b"".join(T.LedgerHeaderHistoryEntry.encode(e)
+                      for e in entries)
+    arch.put_xdr_gz("ledger", checkpoint_name(cp), forged)
+
+    app_b = make_node(tmp_path, archive_dir=arch_dir)
+    work = CatchupWork(app_b, app_b.history_manager.archives[0],
+                       CatchupConfiguration(cp))
+    assert _run_catchup(app_b, work) == State.FAILURE
+    assert app_b.ledger_manager.last_closed_seq() == 1
+
+
+class _FlakyArchive(HistoryArchive):
+    """Fails the first ``fail_n`` fetches of every bucket, then serves
+    normally — the mid-catchup transient-archive-failure model."""
+
+    def __init__(self, name, root, fail_n=2):
+        super().__init__(name, root)
+        self.fail_n = fail_n
+        self.attempts = {}
+        self.failures_injected = 0
+
+    def get_bucket(self, hash_hex):
+        n = self.attempts.get(hash_hex, 0)
+        self.attempts[hash_hex] = n + 1
+        if hash_hex != "00" * 32 and n < self.fail_n:
+            self.failures_injected += 1
+            return None
+        return super().get_bucket(hash_hex)
+
+
+def test_archive_failure_retried_with_backoff(tmp_path):
+    """Transient bucket-fetch failures mid-catchup are retried (with the
+    clock-based backoff) and the catchup still succeeds."""
+    app_a, arch_dir, cp = _published_archive(tmp_path)
+    app_b = make_node(tmp_path, archive_dir=arch_dir)
+    flaky = _FlakyArchive("flaky", str(arch_dir), fail_n=2)
+    work = CatchupWork(app_b, flaky, CatchupConfiguration(cp),
+                       retry_backoff=0.05)
+    assert _run_catchup(app_b, work, max_cranks=20000) == State.SUCCESS
+    assert flaky.failures_injected > 0
+    assert app_b.ledger_manager.last_closed_seq() == cp
+    # bit-identical to the publisher's archived state AT the checkpoint
+    blob = HistoryArchive("t", str(arch_dir)).get_xdr_gz(
+        "ledger", checkpoint_name(cp))
+    from stellar_core_tpu.xdr.runtime import Reader
+
+    r = Reader(blob)
+    last = None
+    while not r.done():
+        last = T.LedgerHeaderHistoryEntry.unpack(r)
+    assert app_b.ledger_manager.last_closed_hash() == last.hash
+    assert app_b.bucket_manager.get_bucket_list_hash() == \
+        last.header.bucketListHash

@@ -256,6 +256,13 @@ class TestCatchup:
                 app_b.config.network_id(), m.txSet)
             app_b.catchup_manager.buffer_externalized(
                 seq, frame, m.ledgerHeader.header.scpValue)
+        # catchup runs ASYNC on B's work scheduler (r17): crank B until
+        # the work completes and the buffer drains
+        for _ in range(20000):
+            if app_b.catchup_manager.catchup_runs >= 1 and \
+                    not app_b.catchup_manager.buffered:
+                break
+            app_b.crank(block=True)
         assert app_b.catchup_manager.catchup_runs >= 1
         assert app_b.ledger_manager.last_closed_seq() == \
             lm_a.last_closed_seq()

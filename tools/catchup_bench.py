@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""Catchup bench (r17): a cold node joins a LIVE simulated network at
+the 1M-entry tier, trailing 1000+ ledgers, while closes keep arriving.
+
+Three phases:
+  1. seed    — a core-2 validator network closes ~1000 ledgers carrying
+               1M create-account entries through real transactions (so
+               complete-mode replay reproduces bit-identical buckets;
+               loadgen's bulk path would bypass the bucket list) and
+               publishes checkpoints to a local archive.
+  2. minimal — a cold node joins mid-traffic, catches up via verified
+               bucket apply + buffered-live-ledger drain; measures
+               time-to-synced, bucket-apply MB/s, verify/apply/replay
+               phase split.
+  3. complete — a second cold node joins with CATCHUP_COMPLETE=True and
+               replays every ledger from genesis; measures ledgers/s
+               replayed.  Acceptance: minimal time-to-synced beats it
+               by >= 5x, and both joiners end bit-identical (header
+               hash + bucketListHash) to the validators.
+
+Usage: python tools/catchup_bench.py [--smoke] [--entries N]
+           [--per-close N] [--out PATH]
+--smoke runs a small tier (fast CI sanity; no 5x assertion).
+"""
+import argparse
+import json
+import os
+import resource
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def rss_mb():
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="small tier: quick correctness pass")
+    ap.add_argument("--entries", type=int, default=None)
+    ap.add_argument("--per-close", type=int, default=None)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    if args.smoke:
+        n_entries = args.entries or 12_000
+        per_close = args.per_close or 400
+        out_path = args.out or "/tmp/CATCHUP_BENCH_smoke.json"
+    else:
+        n_entries = args.entries or 1_000_000
+        per_close = args.per_close or 1_000
+        out_path = args.out or os.path.join(REPO,
+                                            "CATCHUP_BENCH_r17.json")
+    assert per_close % 100 == 0, "per_close must be a multiple of 100"
+
+    import tempfile
+
+    from stellar_core_tpu.crypto import SecretKey, sha256
+    from stellar_core_tpu.simulation.simulation import Simulation
+    from tests.test_catchup import SimAccount
+
+    work_dir = tempfile.mkdtemp(prefix="catchup-bench-")
+    arch_dir = os.path.join(work_dir, "archive")
+
+    # -- build the publisher network ------------------------------------
+    sim = Simulation(network_passphrase="catchup bench network")
+    seeds = [sha256(b"catchup-bench-%d" % i) for i in range(2)]
+    ids = [SecretKey(s).public_key().raw for s in seeds]
+    qset = {"threshold": 2, "validators": ids}
+    common = dict(
+        INVARIANT_CHECKS=[],  # measuring catchup, not the checkers
+        TESTING_UPGRADE_MAX_TX_SET_SIZE=2 * per_close,
+    )
+    for i, s in enumerate(seeds):
+        kw = dict(common)
+        if i == 0:
+            kw["HISTORY_ARCHIVES"] = [("bench", arch_dir)]
+        sim.add_node(s, qset,
+                     node_dir=os.path.join(work_dir, f"v{i}"), **kw)
+    sim.add_connection(ids[0], ids[1])
+    sim.start_all_nodes()
+    for _ in range(200):
+        if sim.crank() == 0:
+            break
+
+    apps = [sim.nodes[i] for i in ids]
+    app_a = apps[0]
+    root = SimAccount(app_a, SecretKey(app_a.config.network_id()))
+    state = {"made": 0, "seq": root.loaded_seq()}
+
+    def inject(n_new):
+        """n_new create-account ops from the root account, <=100 per tx
+        (account ids are raw hashes: the ledger doesn't care, and the
+        bench shouldn't pay 1M pure-python curve derivations)."""
+        while n_new > 0:
+            batch = min(100, n_new)
+            ops = []
+            for _ in range(batch):
+                dest = sha256(b"bench-acct-%d" % state["made"])
+                ops.append(root.op_create_account(dest, 10**7))
+                state["made"] += 1
+            state["seq"] += 1
+            env = root.tx(ops, seq=state["seq"])
+            rc = app_a.herder.recv_transaction(env)
+            assert rc == 0, f"tx rejected: {rc}"
+            n_new -= batch
+
+    def close_validators(traffic):
+        if traffic:
+            inject(traffic)
+        target = max(a.ledger_manager.last_closed_seq()
+                     for a in apps) + 1
+        for a in apps:
+            a.herder.trigger_next_ledger()
+        ok = sim.crank_until(
+            lambda: all(a.ledger_manager.last_closed_seq() >= target
+                        for a in apps), timeout=300)
+        assert ok, f"validators stuck closing {target}"
+
+    # -- phase 1: seed -------------------------------------------------
+    n_seed_ledgers = (n_entries + per_close - 1) // per_close
+    print(f"[seed] {n_entries} entries over {n_seed_ledgers} ledgers "
+          f"({per_close}/close) ...", flush=True)
+    t0 = time.time()
+    remaining = n_entries
+    for k in range(n_seed_ledgers):
+        close_validators(min(per_close, remaining))
+        remaining -= per_close
+        if (k + 1) % 100 == 0:
+            print(f"[seed] {k + 1}/{n_seed_ledgers} ledgers, "
+                  f"{state['made']} entries, "
+                  f"{time.time() - t0:.0f}s, rss {rss_mb():.0f}MB",
+                  flush=True)
+    seed_s = time.time() - t0
+    lcl_after_seed = app_a.ledger_manager.last_closed_seq()
+    print(f"[seed] done: lcl={lcl_after_seed} in {seed_s:.1f}s "
+          f"({state['made'] / seed_s:.0f} entries/s)", flush=True)
+
+    # -- cold join ------------------------------------------------------
+    def join_cold(tag, **extra_cfg):
+        """Add a cold node to the live net and drive it to synced while
+        the validators keep closing (light traffic).  Returns (joiner,
+        node id, wall seconds to synced, trailing gap at join, live
+        closes during catchup)."""
+        seed = sha256(b"catchup-bench-joiner-" + tag.encode())
+        kw = dict(common)
+        kw.update(extra_cfg)
+        kw["HISTORY_ARCHIVES"] = [("bench", arch_dir)]
+        trailing = app_a.ledger_manager.last_closed_seq() - 1
+        t_start = time.time()
+        joiner = sim.add_node(
+            seed, {"threshold": 2, "validators": list(ids)},
+            node_dir=os.path.join(work_dir, f"joiner-{tag}"), **kw)
+        joiner.start()
+        jid = joiner.config.node_id()
+        for vid in ids:
+            sim.add_connection(jid, vid)
+        for _ in range(200):
+            if sim.crank() == 0:
+                break
+
+        def synced():
+            return (joiner.ledger_manager.last_closed_seq() >=
+                    app_a.ledger_manager.last_closed_seq())
+
+        live = 0
+        while not synced():
+            close_validators(20)  # the network does not stop for you
+            live += 1
+            sim.crank_until(synced, timeout=10.0)
+            assert live < 4000, (
+                f"joiner {tag} stuck: "
+                f"{joiner.catchup_manager.status()}")
+        dt = time.time() - t_start
+        # bit-identity: header chain AND bucket list, every shared seq
+        sim.assert_no_forks([ids[0], ids[1], jid])
+        assert (joiner.ledger_manager.last_closed_hash() ==
+                app_a.ledger_manager.last_closed_hash())
+        assert (joiner.bucket_manager.get_bucket_list_hash() ==
+                app_a.bucket_manager.get_bucket_list_hash())
+        return joiner, jid, dt, trailing, live
+
+    def phase_split(app):
+        out = {}
+        for name in ("verify", "apply", "replay"):
+            t = app.metrics.timer(f"catchup.phase.{name}")
+            out[name + "_s"] = round(t.mean * t.count, 3)
+        return out
+
+    # -- phase 2: minimal ----------------------------------------------
+    print("[minimal] cold node joining live network ...", flush=True)
+    min_app, min_id, min_s, min_trailing, min_live = join_cold("minimal")
+    applied_bytes = min_app.metrics.counter(
+        "catchup.bucket.applied-bytes").count
+    applied_entries = min_app.metrics.counter(
+        "catchup.bucket.applied-entries").count
+    min_phases = phase_split(min_app)
+    apply_s = max(min_phases["apply_s"], 1e-9)
+    minimal = {
+        "trailing_ledgers_at_join": min_trailing,
+        "time_to_synced_s": round(min_s, 2),
+        "live_closes_during_catchup": min_live,
+        "catchup_runs": min_app.catchup_manager.catchup_runs,
+        "bucket_applied_bytes": applied_bytes,
+        "bucket_applied_entries": applied_entries,
+        "bucket_apply_mb_s": round(applied_bytes / 2**20 / apply_s, 2),
+        "chain_headers_verified": min_app.metrics.counter(
+            "catchup.chain.verified").count,
+        "phase_split": min_phases,
+        "bit_identical": True,
+    }
+    print(f"[minimal] synced in {min_s:.1f}s "
+          f"(trailing {min_trailing}, "
+          f"{minimal['bucket_apply_mb_s']} MB/s apply)", flush=True)
+
+    # -- phase 3: complete ----------------------------------------------
+    print("[complete] cold node replaying full history ...", flush=True)
+    cmp_app, cmp_id, cmp_s, cmp_trailing, cmp_live = join_cold(
+        "complete", CATCHUP_COMPLETE=True)
+    replayed = cmp_app.metrics.counter("catchup.ledger.replayed").count
+    cmp_phases = phase_split(cmp_app)
+    replay_s = max(cmp_phases["replay_s"], 1e-9)
+    complete = {
+        "trailing_ledgers_at_join": cmp_trailing,
+        "time_to_synced_s": round(cmp_s, 2),
+        "live_closes_during_catchup": cmp_live,
+        "catchup_runs": cmp_app.catchup_manager.catchup_runs,
+        "ledgers_replayed": replayed,
+        "replay_ledgers_per_s": round(replayed / replay_s, 2),
+        "phase_split": cmp_phases,
+        "bit_identical": True,
+    }
+    print(f"[complete] synced in {cmp_s:.1f}s "
+          f"({replayed} ledgers replayed, "
+          f"{complete['replay_ledgers_per_s']}/s)", flush=True)
+
+    speedup = cmp_s / max(min_s, 1e-9)
+    result = {
+        "tier": "smoke" if args.smoke else "1M",
+        "n_entries": state["made"],
+        "seed_ledgers": n_seed_ledgers,
+        "entries_per_close": per_close,
+        "seed_seconds": round(seed_s, 1),
+        "seed_entries_per_s": round(state["made"] / seed_s, 1),
+        "final_lcl": app_a.ledger_manager.last_closed_seq(),
+        "minimal": minimal,
+        "complete": complete,
+        "minimal_speedup_vs_complete": round(speedup, 2),
+        "rss_mb": round(rss_mb(), 1),
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    print(f"[done] speedup {speedup:.1f}x -> {out_path}", flush=True)
+    if not args.smoke:
+        assert min_trailing >= 1000, \
+            f"joiner only trailed {min_trailing} ledgers"
+        assert speedup >= 5.0, \
+            f"minimal catchup only {speedup:.1f}x faster than complete"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,7 +24,7 @@ BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # consensus-critical module prefixes (relative to the package root):
 # nondeterminism here forks validators (ISSUE 3)
 CONSENSUS_DIRS = ("scp", "herder", "ledger", "bucket", "transactions",
-                  "xdr", "crypto", "apply")
+                  "xdr", "crypto", "apply", "catchup", "history", "work")
 # device-kernel modules: host-side effects inside jax.jit break
 # trace/replay determinism
 KERNEL_DIRS = ("ops",)

@@ -45,7 +45,12 @@ Usage: python tools/verify_green.py            -> exit 0 iff green
            are bugs now); --skip-fee-smoke skips the batched fee-phase
            gate (tools/parallel_apply_bench.py --fee-smoke: NATIVE_FEE
            on/off closes bit-identical AND the charge_fees batch
-           carries >= 90% of closes on the mixed workload).
+           carries >= 90% of closes on the mixed workload);
+           --skip-catchup-smoke skips the cold-join catchup gate
+           (tools/catchup_bench.py --smoke: a cold node joins a live
+           core-2 net mid-traffic, catches up via verified bucket
+           apply AND full replay, both ending bit-identical to the
+           validators).
 """
 import json
 import os
@@ -404,6 +409,55 @@ def run_forensics_smoke() -> "tuple":
     return problems, summary
 
 
+def run_catchup_smoke() -> "tuple":
+    """The fast-catchup gate (tools/catchup_bench.py --smoke): a small
+    cold-join scenario — seed a core-2 net with traffic, publish
+    checkpoints, then a minimal-mode joiner AND a complete-mode joiner
+    each sync against the live network (closes keep arriving) and must
+    end bit-identical (header hash + bucketListHash) to the validators.
+    The 5x minimal-vs-complete speedup assertion is full-tier only; at
+    smoke scale this checks correctness, not the ratio.  Returns
+    (problems, summary)."""
+    out = "/tmp/_t1_catchup_smoke.json"
+    cmd = [sys.executable, os.path.join("tools", "catchup_bench.py"),
+           "--smoke", "--out", out]
+    print(f"verify_green: [catchup smoke] {' '.join(cmd)}", flush=True)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=900)
+    if proc.returncode != 0:
+        tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-6:])
+        return [f"catchup smoke exited {proc.returncode}: {tail}"], \
+            "failed"
+    try:
+        with open(out) as f:
+            rep = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"catchup smoke report unreadable: {e}"], "failed"
+    problems = []
+    mn, cp = rep.get("minimal", {}), rep.get("complete", {})
+    for tag, row in (("minimal", mn), ("complete", cp)):
+        if row.get("bit_identical") is not True:
+            problems.append(f"catchup smoke: {tag} joiner NOT "
+                            "bit-identical to the validators")
+    if mn.get("bucket_applied_entries", 0) <= 0:
+        problems.append("catchup smoke: minimal joiner applied no "
+                        "bucket entries")
+    if cp.get("ledgers_replayed", 0) <= 0:
+        problems.append("catchup smoke: complete joiner replayed no "
+                        "ledgers")
+    summary = (f"minimal {mn.get('time_to_synced_s')}s "
+               f"(trailing {mn.get('trailing_ledgers_at_join')}, "
+               f"{mn.get('bucket_apply_mb_s')} MB/s apply, "
+               f"{mn.get('chain_headers_verified')} headers verified), "
+               f"complete {cp.get('time_to_synced_s')}s "
+               f"({cp.get('ledgers_replayed')} ledgers replayed), "
+               f"speedup {rep.get('minimal_speedup_vs_complete')}x, "
+               f"both bit-identical")
+    return problems, summary
+
+
 def run_soak_smoke() -> "tuple":
     """A ~30-clock-second sustained-load soak (tools/soak_bench.py
     --smoke): rate-mode load on a disk-backed REAL_TIME node, then the
@@ -482,6 +536,7 @@ def main() -> int:
     skip_credit = "--skip-credit-smoke" in sys.argv
     skip_fee = "--skip-fee-smoke" in sys.argv
     skip_forensics = "--skip-forensics-smoke" in sys.argv
+    skip_catchup = "--skip-catchup-smoke" in sys.argv
     if smoke_only:
         cmd = tier1_command()
         problems, passed, summary = run_parallel_smoke(cmd)
@@ -583,6 +638,11 @@ def main() -> int:
         print(f"verify_green: forensics smoke: {fo_summary}", flush=True)
         problems.extend(fo_problems)
         smoke_note += f", forensics smoke: {fo_summary}"
+    if not skip_catchup:
+        cu_problems, cu_summary = run_catchup_smoke()
+        print(f"verify_green: catchup smoke: {cu_summary}", flush=True)
+        problems.extend(cu_problems)
+        smoke_note += f", catchup smoke: {cu_summary}"
     if problems:
         print(f"verify_green: RED ({'; '.join(problems)}); "
               f"passed={passed}", flush=True)
