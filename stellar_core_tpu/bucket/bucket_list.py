@@ -395,7 +395,9 @@ class BucketList:
         # not-yet-live merge output
         import threading as _threading
 
-        self._bg_lock = _threading.Lock()
+        from ..utils.lockdep import register_lock
+
+        self._bg_lock = register_lock(_threading.Lock(), "bucket.bg")
         self._bg_outputs: set = set()  # guarded-by: _bg_lock
         # merge-pipeline observability (surfaced via /metrics and bench):
         # sync_fallback_merges MUST stay 0 in steady state — it counts
@@ -863,7 +865,9 @@ class BucketManager:
         # can never lose its file to a concurrently-firing delete
         import threading as _threading
 
-        self._gc_lock = _threading.Lock()
+        from ..utils.lockdep import register_lock
+
+        self._gc_lock = register_lock(_threading.Lock(), "bucket.gc")
         self._saved: set = set()        # guarded-by: _gc_lock
         # two-pass GC tombstones: a file is only deleted after TWO
         # consecutive passes see it unreferenced, so a background merge

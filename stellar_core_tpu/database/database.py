@@ -12,6 +12,7 @@ from contextlib import contextmanager
 from typing import Optional
 
 from ..ledger.ledger_txn import SCHEMA
+from ..utils.lockdep import guard_fields, register_lock
 
 STATEMENT_CACHE_SIZE = 100
 
@@ -26,7 +27,7 @@ class Database:
         # boundaries are serialized via _write_lock so no thread can
         # commit another's half-written transaction.
         self.conn = sqlite3.connect(path, check_same_thread=False)
-        self._write_lock = threading.RLock()
+        self._write_lock = register_lock(threading.RLock(), "db.write")
         # sqlite's compiled-statement cache IS the prepared-statement
         # cache seam (ref Database::getPreparedStatement)
         self.conn.execute(f"PRAGMA cache_size=-{4096}")
@@ -37,8 +38,9 @@ class Database:
             pass
         self.metrics = metrics
         self.slow_query_seconds = slow_query_seconds
-        self.queries = 0
-        self.slow_queries = 0
+        self.queries = 0       # guarded-by: _write_lock
+        self.slow_queries = 0  # guarded-by: _write_lock
+        guard_fields(self)
 
     # -- the reference's session surface ------------------------------------
 
@@ -93,11 +95,18 @@ class Database:
         self.conn.close()
 
     def _account(self, sql: str, dt: float) -> None:
-        self.queries += 1
+        slow = dt > self.slow_query_seconds
+        # the write paths already hold the re-entrant lock; the lock-free
+        # SELECT path pays one uncontended RLock acquire so the counters
+        # stay exact under the pipelined tail (detlint
+        # conc-unguarded-shared found the lost-increment race)
+        with self._write_lock:
+            self.queries += 1
+            if slow:
+                self.slow_queries += 1
         if self.metrics is not None:
             self.metrics.timer("database.query").update(dt)
-        if dt > self.slow_query_seconds:
-            self.slow_queries += 1
+        if slow:
             from ..utils.logging import get_logger
 
             get_logger("Database").warning(

@@ -10,8 +10,10 @@ list, and the HAS json.  Publishing runs as Work items on the app's
 WorkScheduler (the Work system's first consumer)."""
 from __future__ import annotations
 
+import threading
 from typing import List, Optional
 
+from ..utils.lockdep import register_lock
 from ..work.work import BasicWork, State
 from ..xdr import types as T
 from ..xdr import xdr_sha256
@@ -41,8 +43,14 @@ class HistoryManager:
         # replay can never leave a node that silently never publishes
         # again (the old bare-flag failure mode)
         self._suppress_publish_depth = 0
-        # buckets referenced by queued-but-unpublished checkpoints
-        self._pinned = {}
+        # buckets referenced by queued-but-unpublished checkpoints.
+        # Written from whichever thread runs the close path (main in
+        # sequential mode, the close tail in pipelined mode — detlint
+        # conc-unguarded-shared); reads (_bucket_bytes) stay lock-free:
+        # dict get/snapshot is GIL-atomic and a stale read only re-reads
+        # the bucket from the live list or disk
+        self._pin_lock = register_lock(threading.Lock(), "history.pin")
+        self._pinned = {}  # guarded-by: _pin_lock
 
     @property
     def suppress_publish(self) -> bool:
@@ -140,8 +148,9 @@ class HistoryManager:
                         b for lv in
                         self.app.bucket_manager.bucket_list.levels
                         for b in (lv.curr, lv.snap) if not b.is_empty()]
-                for b in buckets:
-                    self._pinned[b.hash().hex()] = b
+                with self._pin_lock:
+                    for b in buckets:
+                        self._pinned[b.hash().hex()] = b
 
     def publish_queued_history(self) -> None:
         """Run a PublishWork per queued checkpoint.  The queue is a
@@ -177,9 +186,10 @@ class HistoryManager:
             self._store_queue(remaining)
         # unpin buckets no longer referenced by any queued checkpoint
         still = {hh for e in remaining for pair in e[1] for hh in pair}
-        for hh in list(self._pinned):
-            if hh not in still:
-                del self._pinned[hh]
+        with self._pin_lock:
+            for hh in list(self._pinned):
+                if hh not in still:
+                    del self._pinned[hh]
 
     # -- snapshot construction (ref StateSnapshot) --------------------------
 

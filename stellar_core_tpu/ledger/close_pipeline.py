@@ -49,7 +49,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Tuple
 
-from ..utils import tracing
+from ..utils import lockdep, tracing
 
 
 class TailFailure(RuntimeError):
@@ -110,13 +110,14 @@ class ClosePipeline:
         # overlap.  Benches/overlap tests opt out explicitly.
         self.eager_drain = (bool(cfg.MANUAL_CLOSE) if eager is None
                             else bool(eager))
-        self._lock = threading.Lock()
-        # the in-flight tail future, depth <= 1  # guarded-by: _lock
-        self._tail = None
+        self._lock = lockdep.register_lock(threading.Lock(),
+                                           "close_pipeline")
+        # the in-flight tail future, depth <= 1
+        self._tail = None                        # guarded-by: _lock
         self._tail_seq = 0                       # guarded-by: _lock
         # a failed tail is sticky: every later barrier re-raises until
-        # the operator intervenes              # guarded-by: _lock
-        self._failure: Optional[BaseException] = None
+        # the operator intervenes
+        self._failure: Optional[BaseException] = None  # guarded-by: _lock
         self._tail_executor = None
         self._prefetch_executor = None
         self.stats = {
@@ -133,6 +134,7 @@ class ClosePipeline:
         # seam for tests/test_chaos.py      # guarded-by: _lock
         self._hold: Optional[threading.Event] = None
         self._abandoned = False                  # guarded-by: _lock
+        lockdep.guard_fields(self)
 
     # -- executors (lazy: a disabled pipeline owns no threads) -------------
 

@@ -8,9 +8,11 @@ history/meta emission -> SQL commit.
 """
 from __future__ import annotations
 
+import threading
 from typing import List, Optional, Tuple
 
 from ..crypto import SecretKey, sha256
+from ..utils import lockdep
 from ..xdr import types as T, xdr_sha256
 from .ledger_txn import LedgerTxn, LedgerTxnRoot, open_database
 
@@ -18,8 +20,12 @@ GENESIS_LEDGER_SEQ = 1
 
 # last seq a deferred post-close collection ran for (process-global:
 # the interpreter has ONE gc, so one collection per closed seq covers
-# every co-hosted simulated node)
-_LAST_GC_SEQ = -1
+# every co-hosted simulated node).  The lock serializes the dedup
+# check-then-set between one app's sequential close (main thread) and
+# another app's pipelined tail worker — unlocked, both could run the
+# same collection or one could skip it (detlint conc-unguarded-shared)
+_GC_SEQ_LOCK = lockdep.register_lock(threading.Lock(), "ledger.gc_seq")
+_LAST_GC_SEQ = -1  # guarded-by: _GC_SEQ_LOCK
 
 
 class LedgerCloseData:
@@ -51,7 +57,8 @@ class LedgerManager:
         self.pipeline = ClosePipeline(app)
         # serializes last_close_phases finalize (close thread) against
         # the tail's deferred phase publish (worker)
-        self._phases_lock = threading.Lock()
+        self._phases_lock = lockdep.register_lock(threading.Lock(),
+                                                  "ledger.phases")
         # per-phase breakdown of the most recent close (ms), plus
         # cumulative phase timers in the metrics registry — the
         # observability the async merge pipeline is judged by.  Timing
@@ -555,12 +562,26 @@ class LedgerManager:
         # per round (50 FULL ones at the seq%64 cadence) dominate wall
         # time.  One collection per closed seq covers the whole process.
         global _LAST_GC_SEQ
-        if seq == _LAST_GC_SEQ:
-            return
-        _LAST_GC_SEQ = seq
+        with _GC_SEQ_LOCK:
+            if seq == _LAST_GC_SEQ:
+                return
+            _LAST_GC_SEQ = seq
         import gc
 
-        gc.collect(2 if seq % 64 == 0 else 1)
+        full = seq % 64 == 0
+        gc.collect(2 if full else 1)
+        if full and getattr(self.app.config,
+                            "GC_FREEZE_LONG_LIVED", True):
+            # Everything that survived a FULL collection is long-lived
+            # state — adopted buckets, their indexes, XDR caches —
+            # exactly the arena whose gen-2 traversal produced
+            # SOAK_BENCH_r13's 427ms p99 close.  Freeze it into the
+            # permanent generation: the next full collect traverses
+            # only the delta since this checkpoint.  Refcounting still
+            # frees frozen objects (bucket dicts of bytes are acyclic);
+            # only cyclic garbage among frozen survivors would leak,
+            # and the collect(2) above just removed the cycles.
+            gc.freeze()
 
     def _store_bucket_state(self, level_hashes=None, sql_ahead_hex=None,
                             commit: bool = True,

@@ -143,7 +143,11 @@ class AbstractLedgerTxn:
         return self.load(k)
 
 
-class LedgerTxn(AbstractLedgerTxn):
+# instance-confined: a LedgerTxn is built, filled, and committed by ONE
+# thread at a time (main seals it, the pipelined tail commits the staged
+# root state; hand-off happens-before via ClosePipeline._lock), so its
+# fields need no per-field lock
+class LedgerTxn(AbstractLedgerTxn):  # detlint: allow(conc-unguarded-shared)
     def __init__(self, parent: AbstractLedgerTxn):
         self.parent = parent
         if isinstance(parent, (LedgerTxn, LedgerTxnRoot)):

@@ -103,7 +103,16 @@ class Application:
         from ..utils.vitals import VitalsSampler
 
         self.vitals = VitalsSampler(self)
-        self._meta_stream: List = []
+        import threading
+
+        # LedgerCloseMeta ring: appended by whichever thread runs the
+        # close path (main sequential, close tail pipelined — detlint
+        # conc-unguarded-shared); reads (tests, forensics) are lock-free
+        # list snapshots
+        from ..utils.lockdep import register_lock
+
+        self._meta_lock = register_lock(threading.Lock(), "app.meta")
+        self._meta_stream: List = []  # guarded-by: _meta_lock
         self._started = False
         # real-socket mode (enable_tcp): io service + listeners
         self.tcp_io = None
@@ -360,9 +369,10 @@ class Application:
             txProcessing=tx_metas,
             upgradesProcessing=upgrade_metas,
             scpInfo=[]))
-        self._meta_stream.append(meta)
-        if len(self._meta_stream) > 64:
-            self._meta_stream.pop(0)
+        with self._meta_lock:
+            self._meta_stream.append(meta)
+            if len(self._meta_stream) > 64:
+                self._meta_stream.pop(0)
         # METADATA_OUTPUT_STREAM: append framed XDR to a file for
         # downstream consumers (ref LedgerManagerImpl.cpp:738-757; the
         # reference writes to a configured fd/file)

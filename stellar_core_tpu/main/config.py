@@ -182,6 +182,15 @@ class Config:
         # run GC between closes instead of wherever allocation counters
         # trip (a mid-close gen2 cycle costs >1s at 1000-tx closes)
         self.DEFERRED_GC: bool = kw.get("DEFERRED_GC", True)
+        # after each FULL post-close collection (checkpoint cadence),
+        # gc.freeze() the survivors — adopted buckets/indexes — so the
+        # next gen-2 pass traverses only the delta since the last
+        # checkpoint instead of the whole heap (the SOAK_BENCH_r13
+        # 427ms-p99 fix).  Kill switch for leak hunts: frozen objects
+        # are invisible to the cyclic collector (refcounting still
+        # frees them)
+        self.GC_FREEZE_LONG_LIVED: bool = kw.get(
+            "GC_FREEZE_LONG_LIVED", True)
 
         # parallel transaction apply (stellar_core_tpu/apply/): footprint
         # planner + conflict-cluster scheduler + bit-identical concurrent

@@ -15,12 +15,14 @@ import subprocess
 import threading
 from typing import Optional
 
+from ..utils.lockdep import register_lock
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "_native.so")
 _SRCS = [os.path.join(_DIR, f) for f in ("bucket_merge.cpp",
                                          "quorum_enum.cpp")]
 
-_lock = threading.Lock()
+_lock = register_lock(threading.Lock(), "native.lib")
 _lib: Optional[ctypes.CDLL] = None  # guarded-by: _lock
 _tried = False  # guarded-by: _lock
 

@@ -22,7 +22,9 @@ import threading
 import time
 from typing import Optional
 
-_lock = threading.Lock()
+from .lockdep import register_lock
+
+_lock = register_lock(threading.Lock(), "device.probe")
 _result: Optional[bool] = None  # guarded-by: _lock
 
 

@@ -13,17 +13,21 @@ from typing import Dict, List, Optional
 
 
 class Counter:
+    """Lock-free by design: metric updates are observability-only — a
+    lost increment under a GIL-preempted ``+=`` costs one sample, never
+    consensus state (COVERAGE.md "Concurrency analysis")."""
+
     def __init__(self):
         self.count = 0
 
     def inc(self, n: int = 1):
-        self.count += n
+        self.count += n  # detlint: allow(conc-unguarded-shared)
 
     def dec(self, n: int = 1):
-        self.count -= n
+        self.count -= n  # detlint: allow(conc-unguarded-shared)
 
     def set_count(self, n: int):
-        self.count = n
+        self.count = n  # detlint: allow(conc-unguarded-shared)
 
 
 class Gauge:
@@ -56,14 +60,16 @@ class Meter:
         return self._clock.now() if self._clock else time.monotonic()
 
     def mark(self, n: int = 1):
+        # lock-free like Counter: a racing mark can lose one EWMA step
+        # or count — an observability sample, never consensus state
         now = self._now()
         if self._last is not None:
             dt = max(now - self._last, 1e-9)
             inst = n / dt
             alpha = 1 - math.exp(-dt / 60.0)
-            self._rate += alpha * (inst - self._rate)
-        self._last = now
-        self.count += n
+            self._rate += alpha * (inst - self._rate)  # detlint: allow(conc-unguarded-shared)
+        self._last = now  # detlint: allow(conc-unguarded-shared)
+        self.count += n  # detlint: allow(conc-unguarded-shared)
 
     @property
     def one_minute_rate(self) -> float:
@@ -166,8 +172,10 @@ class MetricsRegistry:
     def __init__(self, clock=None):
         import threading
 
+        from .lockdep import register_lock
+
         self._clock = clock
-        self._metrics: Dict[str, object] = {}
+        self._metrics: Dict[str, object] = {}  # guarded-by: _reg_lock
         # bounded-cardinality metric families (bounded_name): family ->
         # admitted member suffixes.  Guarded by _reg_lock.
         self._families: Dict[str, set] = {}
@@ -178,7 +186,7 @@ class MetricsRegistry:
         # lose its updates.  Reads stay lock-free: iteration always
         # goes through sorted(...) whose list materialization is
         # GIL-atomic.
-        self._reg_lock = threading.Lock()
+        self._reg_lock = register_lock(threading.Lock(), "metrics.registry")
 
     def _get(self, name: str, cls, *args):
         m = self._metrics.get(name)
@@ -246,8 +254,9 @@ class MetricsRegistry:
 
     def reset(self) -> None:
         """MetricResetter equivalent for tests."""
-        self._metrics.clear()
-        self._families.clear()
+        with self._reg_lock:
+            self._metrics.clear()
+            self._families.clear()
 
 
 # -- Prometheus exposition ---------------------------------------------------

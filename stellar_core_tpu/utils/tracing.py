@@ -39,6 +39,8 @@ from collections import deque
 from time import perf_counter
 from typing import Dict, List, Optional, Tuple
 
+from .lockdep import register_lock
+
 # pending spans kept between closes; eviction-bounded so a node that
 # never closes (or a test hammering spans from many threads) cannot
 # grow memory without bound
@@ -215,7 +217,7 @@ class Tracer:
         self.slow_close_threshold = slow_close_threshold
         self.trace_dir = trace_dir
         self.metrics = metrics
-        self._lock = threading.Lock()
+        self._lock = register_lock(threading.Lock(), "tracer")
         self._pending: deque = deque(maxlen=max_pending)  # guarded-by: _lock
         self._ring: deque = deque(maxlen=max(1, ring_closes))
         self._id_counter = 0

@@ -49,6 +49,8 @@ from collections import deque
 from time import perf_counter
 from typing import Dict, Iterable, List, Optional
 
+from .lockdep import guard_fields, register_lock
+
 #: lifecycle stages in pipeline order ("fee" = the close's fee/seqnum
 #: charge phase — stamped per tx whether the batched fee kernel or the
 #: per-tx reference loop charged it, so batching keeps attribution)
@@ -81,19 +83,20 @@ class TxLifecycleTracker:
         self.enabled = enabled
         self.metrics = metrics
         self.max_live = max(2, int(max_live))
-        self._lock = threading.Lock()
-        # tx hash -> {stage: perf_counter seconds}   # guarded-by: _lock
-        self._live: Dict[bytes, dict] = {}
-        # completed lifecycle records                # guarded-by: _lock
-        self._ring: deque = deque(maxlen=max(1, int(ring)))
+        self._lock = register_lock(threading.Lock(), "txtrace")
+        # tx hash -> {stage: perf_counter seconds}
+        self._live: Dict[bytes, dict] = {}  # guarded-by: _lock
+        # completed lifecycle records
+        self._ring: deque = deque(maxlen=max(1, int(ring)))  # guarded-by: _lock
         self._stride = 1          # guarded-by: _lock
-        self._seen = 0            # admission candidates offered
-        self._tracked = 0         # txs that entered the live map
-        self._completed = 0       # reached the commit stamp
-        self._decimations = 0
+        self._seen = 0            # guarded-by: _lock
+        self._tracked = 0         # guarded-by: _lock
+        self._completed = 0       # guarded-by: _lock
+        self._decimations = 0     # guarded-by: _lock
         # Histogram objects resolved once per name: the registry's
         # name->metric lookup per completed tx would dominate _finish
-        self._hists: Dict[str, object] = {}
+        self._hists: Dict[str, object] = {}  # guarded-by: _lock
+        guard_fields(self)
 
     # -- stamping ----------------------------------------------------------
 

@@ -179,3 +179,30 @@ def test_vitals_disabled_is_inert_but_reportable():
     assert body["vitals"]["enabled"] is False
     assert body["vitals"]["samples"] == 0
     app.graceful_stop()
+
+
+def test_full_collect_freezes_long_lived_state(monkeypatch):
+    """ISSUE 18 satellite: after the seq%64 FULL collection the close
+    path freezes survivors (adopted buckets, indexes, XDR caches) into
+    the permanent generation so later gen-2 sweeps traverse only the
+    delta — the SOAK_BENCH_r13 427ms-p99 fix.  Young-gen closes must
+    NOT freeze, and GC_FREEZE_LONG_LIVED=False must opt out."""
+    from stellar_core_tpu.ledger import ledger_manager as lm_mod
+
+    calls = []
+    app = _mk_app(DEFERRED_GC=True)
+    monkeypatch.setattr(gc, "freeze", lambda: calls.append(True))
+    try:
+        lm = app.ledger_manager
+        monkeypatch.setattr(lm_mod, "_LAST_GC_SEQ", -1)
+        lm._post_close_gc(63)      # young-gen close: no freeze
+        assert not calls
+        lm._post_close_gc(64)      # checkpoint close: full collect + freeze
+        assert len(calls) == 1
+        lm._post_close_gc(64)      # same seq: process-wide dedup, no repeat
+        assert len(calls) == 1
+        app.config.GC_FREEZE_LONG_LIVED = False
+        lm._post_close_gc(128)     # opted out: full collect, no freeze
+        assert len(calls) == 1
+    finally:
+        app.graceful_stop()

@@ -42,7 +42,14 @@ def main(argv=None) -> int:
     ap.add_argument("--root", default=REPO, help=argparse.SUPPRESS)
     ap.add_argument("--write-baseline", action="store_true",
                     help="regenerate the baseline from current findings")
+    ap.add_argument("--threads", action="store_true",
+                    help="dump the inferred thread model (root "
+                         "inventory + per-context function counts) "
+                         "instead of linting")
     args = ap.parse_args(argv)
+
+    if args.threads:
+        return _dump_threads(args)
 
     if args.write_baseline and args.paths:
         print("detlint: --write-baseline requires a full-repo run — a "
@@ -117,6 +124,46 @@ def main(argv=None) -> int:
                   "a '# detlint: allow(<rule>)' pragma with a reason, or "
                   "baseline them with a justification", file=sys.stderr)
         return 1
+    return 0
+
+
+def _dump_threads(args) -> int:
+    """The thread-root inventory and runs-on context histogram the
+    concurrency rules are judging against (COVERAGE.md documents the
+    model; this prints the live one)."""
+    from .concurrency import build_model_for
+    from .engine import _parse_file, discover_files
+    import os
+
+    infos = []
+    for rel in discover_files(args.root):
+        if not rel.endswith(".py"):
+            continue
+        with open(os.path.join(args.root, rel), encoding="utf-8") as fh:
+            info = _parse_file(rel, fh.read())
+        if info is not None:
+            infos.append(info)
+    m = build_model_for(infos)
+    if args.as_json:
+        print(json.dumps({
+            "roots": m.roots,
+            "contexts": {k: sorted(v) for k, v in
+                         sorted(m.contexts.items()) if v},
+        }, indent=1))
+        return 0
+    print("thread roots:")
+    for r in m.roots:
+        status = ", ".join(r["resolved"]) if r["resolved"] \
+            else "UNRESOLVED"
+        print(f"  {r['file']}:{r['line']}: {r['ctx']} <- "
+              f"{r['target']} ({status})")
+    hist = {}
+    for ctxs in m.contexts.values():
+        label = "+".join(sorted(ctxs)) if ctxs else "<unreached>"
+        hist[label] = hist.get(label, 0) + 1
+    print("runs-on histogram (functions per context set):")
+    for label in sorted(hist):
+        print(f"  {hist[label]:4d}  {label}")
     return 0
 
 

@@ -26,7 +26,7 @@ import json
 import os
 from typing import Dict, List, Optional, Tuple
 
-from . import callgraph
+from . import callgraph, threadmodel
 from .engine import (
     NATIVE_EXTS, REPO, FileInfo, Finding, _parse_file, _suppressed,
     discover_files, light_info,
@@ -102,7 +102,7 @@ def lint_changed(root: str = REPO,
                  ) -> Tuple[List[Finding], dict]:
     """Incremental full-accuracy run.  Returns (findings, stats) where
     stats = {"changed": [...], "reused": n}."""
-    from . import interproc, native
+    from . import concurrency, interproc, native
 
     cpath = path or cache_path(root)
     tools_sha = _tools_fingerprint()
@@ -117,6 +117,7 @@ def lint_changed(root: str = REPO,
     parsed_py: List[FileInfo] = []
     aux_infos: List[FileInfo] = []
     summaries: Dict[str, List[callgraph.FuncSummary]] = {}
+    conc_map: Dict[str, threadmodel.FileConc] = {}
     native_infos = []
 
     for rel in relpaths:
@@ -125,11 +126,15 @@ def lint_changed(root: str = REPO,
         texts[rel] = text
         digest = hashlib.sha256(text.encode("utf-8")).hexdigest()
         ent = old_files.get(rel)
-        if ent is not None and ent.get("sha256") == digest:
+        usable = ent is not None and ent.get("sha256") == digest and \
+            (rel.endswith(NATIVE_EXTS) or ent.get("conc") is not None)
+        if usable:
             findings.extend(Finding(**f) for f in ent["findings"])
             if not rel.endswith(NATIVE_EXTS):
                 summaries[rel] = [callgraph.FuncSummary.from_json(s)
                                   for s in ent.get("summaries", [])]
+                conc_map[rel] = threadmodel.FileConc.from_json(
+                    ent["conc"])
                 aux_infos.append(light_info(rel, text))
             else:
                 aux_infos.append(native.parse_native(rel, text))
@@ -159,7 +164,9 @@ def lint_changed(root: str = REPO,
                      "findings": [_finding_to_json(f)
                                   for f in file_findings],
                      "summaries": [s.to_json() for s in
-                                   callgraph.summarize_file(info)]}
+                                   callgraph.summarize_file(info)],
+                     "conc": threadmodel.summarize_conc(
+                         info).to_json()}
         findings.extend(file_findings)
         new_files[rel] = entry
 
@@ -167,8 +174,16 @@ def lint_changed(root: str = REPO,
     global_findings: List[Finding] = []
     global_findings.extend(
         interproc.check(parsed_py, summaries, tuple(aux_infos)))
+    conc_findings, exonerated = concurrency.check(
+        parsed_py, conc_map, tuple(aux_infos))
+    global_findings.extend(conc_findings)
     global_findings.extend(native.check_lockstep(texts, root=root))
     global_findings.extend(native.check_srchash(root))
+
+    # the interprocedural held-on-entry proof discharges cached v1
+    # lock-unguarded-write findings too — same verdict as a cold run
+    findings = [f for f in findings
+                if not concurrency.exonerates(f, exonerated)]
 
     by_path = {i.path: i for i in parsed_py}
     by_path.update({i.path: i for i in native_infos})

@@ -229,7 +229,7 @@ def lint_sources(sources: Dict[str, str],
     the whole-program interprocedural taint pass; .cpp/.c files run the
     native auditor.  ``root`` (set by lint_paths/lint_repo) additionally
     enables the filesystem-backed srchash sidecar audit."""
-    from . import interproc, native
+    from . import concurrency, interproc, native
 
     infos: List[FileInfo] = []
     native_infos: List["native.NativeInfo"] = []
@@ -252,6 +252,8 @@ def lint_sources(sources: Dict[str, str],
     for info in infos:
         findings.extend(check_py_file(info))
     findings.extend(interproc.check(infos))
+    conc_findings, exonerated = concurrency.check(infos)
+    findings.extend(conc_findings)
     findings.extend(native.check(
         native_infos,
         py_sources={i.path: i.source for i in infos},
@@ -263,6 +265,10 @@ def lint_sources(sources: Dict[str, str],
     for f in findings:
         info = by_path.get(f.file)
         if info is not None and _suppressed(info, f):
+            continue
+        if concurrency.exonerates(f, exonerated):
+            # the thread model proved the lock held on entry from every
+            # resolved caller — the v1 lexical miss is discharged
             continue
         out.append(f)
     return sorted(out, key=lambda f: (f.file, f.line, f.col, f.rule))

@@ -129,6 +129,60 @@ def _seed_and_fold(app, lg, n: int, close_txs: int) -> None:
         f"overlay — the fold failed"
 
 
+def lockdep_probe(n_closes: int, close_txs: int, workers: int) -> dict:
+    """Per-close witness accounting for the --lockdep-smoke overhead
+    gate: run ``n_closes`` pipelined pay closes on one app and report
+    the lock acquisitions + guarded-field checks the witness performed
+    PER CLOSE (lockdep.stats() delta across the timed loop only —
+    seeding excluded) alongside the round-trip close p50.  Meaningful
+    under LOCKDEP=1; with the witness disabled the counts are zero and
+    the report says so."""
+    import shutil
+    import tempfile
+
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+    from stellar_core_tpu.utils import lockdep
+
+    node_dir = tempfile.mkdtemp(prefix="lockdep-probe-")
+    app = _mk_app(workers, node_dir=node_dir)
+    lg = LoadGenerator(app)
+    lg.payment_pattern = "pairs"
+    n_accounts = max(2 * close_txs, 4 * close_txs)
+    _seed_and_fold(app, lg, n_accounts, close_txs)
+    n_slices = max(1, n_accounts // close_txs)
+    before = lockdep.stats()
+    walls = []
+    for i in range(n_closes):
+        lo = (i % n_slices) * close_txs
+        hi = ((i + 1) % n_slices) * close_txs
+        envs = lg.generate_payments(
+            close_txs, accounts=lg.accounts[lo:lo + close_txs],
+            dest_accounts=lg.accounts[hi:hi + close_txs])
+        admitted = sum(1 for env in envs
+                       if app.herder.recv_transaction(env) == 0)
+        assert admitted == close_txs, f"only {admitted} admitted"
+        t0 = time.perf_counter()
+        app.herder.manual_close()
+        walls.append((time.perf_counter() - t0) * 1000.0)
+    app.ledger_manager.pipeline.drain()
+    after = lockdep.stats()
+    app.graceful_stop()
+    shutil.rmtree(node_dir, ignore_errors=True)
+    return {
+        "enabled": after["enabled"],
+        "closes": n_closes,
+        "close_txs": close_txs,
+        "close_p50_ms": _p50(walls),
+        "acquires_per_close": round(
+            (after["acquires"] - before["acquires"]) / n_closes, 1),
+        "guard_checks_per_close": round(
+            (after["guard_checks"] - before["guard_checks"]) / n_closes,
+            1),
+        "inversions": after["inversions"],
+        "guard_violations": after["guard_violations"],
+    }
+
+
 def bench_workload(shape: str, n_closes: int, close_txs: int,
                    dex_pct: int, workers: int) -> dict:
     import shutil
@@ -286,6 +340,15 @@ def main() -> None:
     close_txs = int(os.environ.get("BENCH_CLOSE_TXS", "1000"))
     dex_pct = int(os.environ.get("BENCH_DEX_PCT", "30"))
     workers = int(os.environ.get("BENCH_WORKERS", "2"))
+
+    if "--lockdep-probe" in sys.argv:
+        row = lockdep_probe(max(4, n_closes), close_txs, workers)
+        _note(f"lockdep probe: {row}")
+        path = os.environ.get("PIPELINE_BENCH_OUT",
+                              "/tmp/_lockdep_probe.json")
+        with open(path, "w") as f:
+            json.dump(row, f, indent=2)
+        return
 
     rows = [bench_workload(shape, n_closes, close_txs, dex_pct, workers)
             for shape in ("pay", "mixed")]

@@ -50,7 +50,15 @@ Usage: python tools/verify_green.py            -> exit 0 iff green
            (tools/catchup_bench.py --smoke: a cold node joins a live
            core-2 net mid-traffic, catches up via verified bucket
            apply AND full replay, both ending bit-identical to the
-           validators).
+           validators); --skip-lockdep-smoke skips the runtime
+           lockdep-witness gate.
+       python tools/verify_green.py --lockdep-smoke -> ONLY the
+           runtime witness gate: the threaded-subsystem tier-1 subset,
+           one core-4 chaos scenario and one pipelined-close bench
+           iteration all under LOCKDEP=1 (every registered lock
+           order-witnessed, every # guarded-by: write assert-held
+           checked), zero LockOrderInversion/GuardViolation required,
+           plus the <1%-of-close-p50 witness-overhead micro-gate.
 """
 import json
 import os
@@ -513,6 +521,185 @@ def run_soak_smoke() -> "tuple":
     return problems, summary
 
 
+#: threaded-subsystem tier-1 subset the lockdep witness re-runs: every
+#: file that exercises the pipelined close, the bucket background
+#: merge/GC, or a registered lock directly
+LOCKDEP_T1_SUBSET = [
+    "tests/test_lockdep.py",
+    "tests/test_pipelined_close.py",
+    "tests/test_bucket_list.py",
+    "tests/test_metrics.py",
+    "tests/test_txtrace.py",
+    "tests/test_tracing.py",
+]
+
+def run_lockdep_smoke() -> "tuple":
+    """The detlint-v3 runtime witness gate, everything under LOCKDEP=1:
+    (a) the threaded-subsystem tier-1 subset, (b) one core-4
+    partition+heal chaos scenario, (c) one pipelined-close bench
+    iteration — all with every registered lock wrapped and every
+    ``# guarded-by:`` field write assert-held-checked; ANY
+    LockOrderInversion or GuardViolation is red.  A per-acquire
+    micro-benchmark then bounds the enabled-witness cost at <1% of the
+    close p50 the bench just measured.  Returns (problems, summary)."""
+    problems = []
+    env = dict(os.environ)
+    env["LOCKDEP"] = "1"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    # (a) the threaded tier-1 subset
+    log_path = "/tmp/_t1_lockdep.log"
+    sub_cmd = (f"timeout -k 10 600 {sys.executable} -m pytest "
+               f"{' '.join(LOCKDEP_T1_SUBSET)} -q -m 'not slow' "
+               f"-p no:cacheprovider -p no:xdist -p no:randomly "
+               f"> {log_path} 2>&1")
+    print(f"verify_green: [lockdep smoke] LOCKDEP=1 {sub_cmd}",
+          flush=True)
+    proc = subprocess.run(["bash", "-c", sub_cmd], cwd=REPO, env=env)
+    try:
+        with open(log_path, errors="replace") as f:
+            log = f.read()
+    except OSError:
+        log = ""
+    if proc.returncode != 0:
+        problems.append(f"lockdep smoke: subset exited {proc.returncode}")
+    tail = "\n".join(log.splitlines()[-30:])
+    m = re.search(r"\b([1-9]\d*) failed\b", tail)
+    if m:
+        problems.append(f"lockdep smoke: {m.group(1)} failed tests")
+    m = re.search(r"\b(\d+) passed\b", tail)
+    passed = m.group(1) if m else "?"
+
+    # (b) one chaos scenario with the witness armed
+    chaos_out = "/tmp/_t1_lockdep_chaos.json"
+    chaos_cmd = [sys.executable, "-m", "tools.chaos_bench", "--tier",
+                 "core4", "--scenario", "partition_heal", "--out",
+                 chaos_out]
+    print(f"verify_green: [lockdep smoke] LOCKDEP=1 "
+          f"{' '.join(chaos_cmd)}", flush=True)
+    chaos = subprocess.run(chaos_cmd, cwd=REPO, env=env,
+                           capture_output=True, text=True, timeout=600)
+    chaos_note = "ok"
+    if chaos.returncode != 0:
+        tail2 = "\n".join((chaos.stdout + chaos.stderr).splitlines()[-6:])
+        problems.append(
+            f"lockdep smoke: chaos exited {chaos.returncode}: {tail2}")
+        chaos_note = "failed"
+    log += chaos.stdout + chaos.stderr
+
+    # (c) one pipelined-close bench iteration with the witness armed:
+    # the probe mode runs pay closes on one app and reports the
+    # lockdep.stats() DELTA across the timed loop — the measured
+    # acquires + guarded-field checks PER CLOSE, plus the close p50
+    # those closes actually achieved under the witness
+    bench_out = "/tmp/_t1_lockdep_pipeline.json"
+    bench_env = dict(env)
+    bench_env.update({"BENCH_CLOSES": "6", "BENCH_CLOSE_TXS": "120",
+                      "PIPELINE_BENCH_OUT": bench_out})
+    bench_cmd = [sys.executable, os.path.join("tools",
+                                              "pipeline_bench.py"),
+                 "--lockdep-probe"]
+    print(f"verify_green: [lockdep smoke] LOCKDEP=1 "
+          f"{' '.join(bench_cmd)}", flush=True)
+    bench = subprocess.run(bench_cmd, cwd=REPO, env=bench_env,
+                           capture_output=True, text=True, timeout=600)
+    probe = None
+    if bench.returncode != 0:
+        tail3 = "\n".join((bench.stdout + bench.stderr).splitlines()[-6:])
+        problems.append(
+            f"lockdep smoke: pipeline probe exited {bench.returncode}: "
+            f"{tail3}")
+    else:
+        try:
+            with open(bench_out) as f:
+                probe = json.load(f)
+            if probe.get("inversions") or probe.get("guard_violations"):
+                problems.append(
+                    f"lockdep smoke: probe saw "
+                    f"{probe.get('inversions')} inversions / "
+                    f"{probe.get('guard_violations')} guard violations")
+        except (OSError, ValueError) as e:
+            problems.append(
+                f"lockdep smoke: probe report unreadable: {e}")
+    log += bench.stdout + bench.stderr
+
+    # zero-violations gate: inversions/guard trips raise and fail their
+    # run above, but scan the combined output too so a swallowed one
+    # still reds the gate with its name attached
+    for marker in ("LockOrderInversion", "GuardViolation"):
+        n = log.count(marker)
+        if n:
+            problems.append(f"lockdep smoke: {n} {marker} in output")
+
+    # overhead gate: A/B micro-bench of the enabled witness (wrapped vs
+    # raw lock, plus one guarded-field check), scaled by the per-close
+    # counts the probe just MEASURED, bounded at <1% of the probe's
+    # close p50
+    micro = subprocess.run(
+        [sys.executable, "-c", (
+            "import json, threading, time\n"
+            "from stellar_core_tpu.utils import lockdep\n"
+            "raw = threading.Lock()\n"
+            "wit = lockdep.register_lock(threading.Lock(), 'bench')\n"
+            "assert isinstance(wit, lockdep.WitnessLock)\n"
+            "def per_acquire(lk, n=200000):\n"
+            "    for _ in range(n // 10):\n"
+            "        with lk:\n"
+            "            pass\n"
+            "    t0 = time.perf_counter()\n"
+            "    for _ in range(n):\n"
+            "        with lk:\n"
+            "            pass\n"
+            "    return (time.perf_counter() - t0) / n\n"
+            "class B:\n"
+            "    pass\n"
+            "b = B()\n"
+            "b.__dict__['_lock'] = wit\n"
+            "b.__dict__['_lockdep_enforced'] = True\n"
+            "desc = lockdep._GuardedField('val', '_lock')\n"
+            "def per_check(n=200000):\n"
+            "    with wit:\n"
+            "        t0 = time.perf_counter()\n"
+            "        for i in range(n):\n"
+            "            desc.__set__(b, i)\n"
+            "        return (time.perf_counter() - t0) / n\n"
+            "print(json.dumps({'raw_us': per_acquire(raw) * 1e6,\n"
+            "                  'wit_us': per_acquire(wit) * 1e6,\n"
+            "                  'check_us': per_check() * 1e6}))\n")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    overhead_note = "unmeasured"
+    if micro.returncode != 0:
+        problems.append("lockdep smoke: overhead micro-bench failed: "
+                        + "\n".join(micro.stderr.splitlines()[-3:]))
+    elif probe is not None:
+        try:
+            row = json.loads(micro.stdout.strip().splitlines()[-1])
+            acq_us = max(0.0, row["wit_us"] - row["raw_us"])
+            chk_us = row["check_us"]
+            per_close_ms = (
+                acq_us * probe.get("acquires_per_close", 0.0)
+                + chk_us * probe.get("guard_checks_per_close", 0.0)
+            ) / 1000.0
+            p50 = probe.get("close_p50_ms") or 20.0
+            pct = per_close_ms / p50 * 100.0
+            overhead_note = (
+                f"{acq_us:.2f}us x {probe.get('acquires_per_close')} "
+                f"acquires + {chk_us:.2f}us x "
+                f"{probe.get('guard_checks_per_close')} checks = "
+                f"{per_close_ms:.3f}ms/close = {pct:.2f}% of close "
+                f"p50 {p50}ms")
+            if pct >= 1.0:
+                problems.append(
+                    f"lockdep smoke: witness overhead {overhead_note} "
+                    f"(gate: <1%)")
+        except (ValueError, KeyError, IndexError) as e:
+            problems.append(
+                f"lockdep smoke: overhead report unreadable: {e}")
+    summary = (f"subset passed={passed}, chaos {chaos_note}, "
+               f"0 violations, witness overhead {overhead_note}")
+    return problems, summary
+
+
 def main() -> int:
     timings = "--timings" in sys.argv
     if "--lint-only" in sys.argv:
@@ -527,6 +714,17 @@ def main() -> int:
         print("verify_green: LINT GREEN (detlint --strict clean)",
               flush=True)
         return 0
+    if "--lockdep-smoke" in sys.argv:
+        # standalone runtime-witness gate: everything under LOCKDEP=1
+        ld_problems, ld_summary = run_lockdep_smoke()
+        print(f"verify_green: lockdep smoke: {ld_summary}", flush=True)
+        if ld_problems:
+            print(f"verify_green: RED ({'; '.join(ld_problems)})",
+                  flush=True)
+            return 1
+        print(f"verify_green: GREEN (lockdep smoke: {ld_summary})",
+              flush=True)
+        return 0
     smoke_only = "--parallel-smoke-only" in sys.argv
     skip_smoke = "--skip-parallel-smoke" in sys.argv
     skip_fallback = "--skip-fallback-smoke" in sys.argv
@@ -537,6 +735,7 @@ def main() -> int:
     skip_fee = "--skip-fee-smoke" in sys.argv
     skip_forensics = "--skip-forensics-smoke" in sys.argv
     skip_catchup = "--skip-catchup-smoke" in sys.argv
+    skip_lockdep = "--skip-lockdep-smoke" in sys.argv
     if smoke_only:
         cmd = tier1_command()
         problems, passed, summary = run_parallel_smoke(cmd)
@@ -643,6 +842,11 @@ def main() -> int:
         print(f"verify_green: catchup smoke: {cu_summary}", flush=True)
         problems.extend(cu_problems)
         smoke_note += f", catchup smoke: {cu_summary}"
+    if not skip_lockdep:
+        ld_problems, ld_summary = run_lockdep_smoke()
+        print(f"verify_green: lockdep smoke: {ld_summary}", flush=True)
+        problems.extend(ld_problems)
+        smoke_note += f", lockdep smoke: {ld_summary}"
     if problems:
         print(f"verify_green: RED ({'; '.join(problems)}); "
               f"passed={passed}", flush=True)
