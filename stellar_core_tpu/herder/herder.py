@@ -509,6 +509,20 @@ class Herder:
 
     def recv_scp_envelope(self, env) -> EnvelopeState:
         """ref recvSCPEnvelope :624 + PendingEnvelopes fetch logic."""
+        prof = self.app.clock.profiler
+        if prof is None:
+            return self._recv_scp_envelope(env)
+        # crank wall attribution: SCP ingest (quorum-slice evaluation
+        # included) usually runs inside an overlay delivery dispatch —
+        # carve it into "consensus"; a close it triggers nests into
+        # "ledger" via LedgerManager's own scope
+        tok = prof.scope_begin("consensus")
+        try:
+            return self._recv_scp_envelope(env)
+        finally:
+            prof.scope_end(tok)
+
+    def _recv_scp_envelope(self, env) -> EnvelopeState:
         lo, hi = self.scp_slot_bracket()
         slot = env.statement.slotIndex
         if not lo <= slot <= hi:

@@ -154,6 +154,18 @@ class LedgerManager:
 
     def close_ledger(self, close_data: LedgerCloseData) -> None:
         """ref closeLedger :669-933."""
+        prof = self.app.clock.profiler
+        if prof is None:
+            return self._close_ledger_timed(close_data)
+        # crank wall attribution: close work runs inside whatever
+        # dispatch externalized the value — carve it into "ledger"
+        tok = prof.scope_begin("ledger")
+        try:
+            return self._close_ledger_timed(close_data)
+        finally:
+            prof.scope_end(tok)
+
+    def _close_ledger_timed(self, close_data: LedgerCloseData) -> None:
         from ..utils.logging import LogSlowExecution
 
         tracer = self.app.tracer

@@ -62,6 +62,18 @@ class Application:
             enabled=config.TX_LIFECYCLE_TRACKING,
             max_live=config.TX_LIFECYCLE_MAX_LIVE,
             ring=config.TX_LIFECYCLE_RING)
+        # flood-propagation telemetry: per-item hop records across the
+        # overlay flood, stamped on the shared clock so a simulation's
+        # nodes produce cross-comparable (and deterministic) timelines
+        # (utils/floodtrace.py; merged by simulation/observatory.py)
+        from ..utils.floodtrace import FloodPropagationTracker
+
+        self.floodtracer = FloodPropagationTracker(
+            metrics=self.metrics,
+            enabled=config.FLOOD_TRACE_ENABLED,
+            now=clock.now,
+            max_live=config.FLOOD_TRACE_MAX_LIVE,
+            ring=config.FLOOD_TRACE_RING)
         self.scheduler = Scheduler(clock)
         from ..database import Database
 

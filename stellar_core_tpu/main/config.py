@@ -317,6 +317,22 @@ class Config:
         self.TX_LIFECYCLE_MAX_LIVE: int = kw.get(
             "TX_LIFECYCLE_MAX_LIVE", 512)
 
+        # flood-propagation telemetry (utils/floodtrace.py): sampled
+        # per-item hop records across the overlay flood (origin vs
+        # relayed, sending peer, duplicate attribution, fan-out),
+        # rolled into floodtrace.* metrics and the HTTP flood endpoint;
+        # simulation/observatory.py merges them network-wide.
+        # Observational only — hashes/meta are bit-identical on or off
+        # and the disabled cost is one attribute check per flood site.
+        self.FLOOD_TRACE_ENABLED: bool = kw.get(
+            "FLOOD_TRACE_ENABLED", True)
+        # retired hop records retained for flood / the observatory
+        self.FLOOD_TRACE_RING: int = kw.get("FLOOD_TRACE_RING", 256)
+        # in-flight tracked items before deterministic decimation
+        # halves the live map and doubles the sampling stride
+        self.FLOOD_TRACE_MAX_LIVE: int = kw.get(
+            "FLOOD_TRACE_MAX_LIVE", 512)
+
         # continuous node-vitals sampler (utils/vitals.py): periodic
         # RSS/fd/thread/queue/bucket/GC gauges in a bounded ring with
         # per-gauge slope estimation, vitals.* Prometheus gauges, the
@@ -417,6 +433,10 @@ class Config:
             raise ConfigError(
                 "TX_LIFECYCLE_RING must be >= 1 and "
                 "TX_LIFECYCLE_MAX_LIVE >= 2")
+        if self.FLOOD_TRACE_RING < 1 or self.FLOOD_TRACE_MAX_LIVE < 2:
+            raise ConfigError(
+                "FLOOD_TRACE_RING must be >= 1 and "
+                "FLOOD_TRACE_MAX_LIVE >= 2")
         if self.SCP_TIMELINE_SLOTS < 1 or \
                 self.SCP_TIMELINE_EVENTS_PER_SLOT < 8:
             raise ConfigError(

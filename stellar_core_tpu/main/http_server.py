@@ -66,6 +66,8 @@ class CommandHandler:
             "tx/latency": self.tx_latency,
             "vitals": self.vitals,
             "catchup-status": self.catchup_status,
+            "flood": self.flood,
+            "network-observatory": self.network_observatory,
         }
 
     def handle(self, path: str, params: Dict[str, str]) -> tuple:
@@ -541,6 +543,37 @@ class CommandHandler:
         if params.get("sample") == "true":
             self.app.vitals.sample_once()
         return 200, {"vitals": self.app.vitals.report()}
+
+    def flood(self, params):
+        """flood?hash=<hex> — this node's hop record for one flood item
+        (origin/relayed, first-seen link, duplicate arrivals, forward
+        fan-out).  Without ?hash: tracker stats + registry rollups +
+        per-link dedup ratios + the most recent hop records
+        (?last=N, default 16)."""
+        ft = self.app.floodtracer
+        if "hash" in params:
+            try:
+                h = bytes.fromhex(params["hash"])
+            except ValueError:
+                return 400, {"error": "bad hash param (want hex)"}
+            rec = ft.lookup(h)
+            if rec is None:
+                return 404, {"error": f"no hop record for {params['hash']}"
+                             " (untracked, sampled out, or evicted)",
+                             "stats": ft.stats()}
+            return 200, {"flood": rec}
+        return 200, {"flood": ft.report(last=int(params.get("last", "16")))}
+
+    def network_observatory(self, params):
+        """network-observatory — fleet-merged propagation/close-cadence
+        view.  Only live on sim rigs, where the Simulation attached a
+        NetworkObservatory to every node; real nodes aggregate via
+        tools/fleet_scrape.py instead."""
+        obs = getattr(self.app, "_observatory", None)
+        if obs is None:
+            return 400, {"error": "no observatory attached "
+                         "(sim rigs only; real fleets: tools/fleet_scrape.py)"}
+        return 200, {"observatory": obs.snapshot()}
 
     def trace_summary(self, params):
         """trace/summary?k=N — top-k self-time spans aggregated over the

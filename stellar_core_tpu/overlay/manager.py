@@ -20,16 +20,22 @@ class Floodgate:
     def __init__(self):
         # msg hash -> {"peers": set of peer_ids that have it, "seq": ledger}
         self.records: Dict[bytes, dict] = {}
+        # observational GC hook: clear_below hands the dropped hashes to
+        # the flood tracker so tracked hop records retire into its ring;
+        # never influences routing
+        self.on_clear = None
 
     @staticmethod
     def msg_id(msg) -> bytes:
         return sha256(O.StellarMessage.encode(msg))
 
     def add_record(self, msg, from_peer_id: Optional[bytes],
-                   ledger_seq: int) -> bool:
+                   ledger_seq: int, h: Optional[bytes] = None) -> bool:
         """Returns True if the message is NEW (should be processed +
-        forwarded)."""
-        h = self.msg_id(msg)
+        forwarded).  Callers that already hashed the message pass ``h``
+        so the flood path hashes each message once."""
+        if h is None:
+            h = self.msg_id(msg)
         rec = self.records.get(h)
         if rec is None:
             rec = self.records[h] = {"peers": set(), "seq": ledger_seq}
@@ -40,8 +46,10 @@ class Floodgate:
             rec["peers"].add(from_peer_id)
         return False
 
-    def peers_to_send(self, msg, authenticated_peers) -> List:
-        h = self.msg_id(msg)
+    def peers_to_send(self, msg, authenticated_peers,
+                      h: Optional[bytes] = None) -> List:
+        if h is None:
+            h = self.msg_id(msg)
         rec = self.records.setdefault(
             h, {"peers": set(), "seq": 0})
         out = [p for p in authenticated_peers
@@ -50,10 +58,28 @@ class Floodgate:
             rec["peers"].add(p.peer_id)
         return out
 
+    def forget_peer(self, peer_id: bytes) -> int:
+        """Drop a departed CONNECTION's footprint from every flood
+        record (the reconnect-churn fix): the records are per-connection
+        state in the reference (keyed by Peer pointer), but here they
+        key on the node id, so without this a reconnecting peer would
+        inherit the dead connection's have-set — never re-flooded items
+        it lost with the old socket, and blamed for their duplicate
+        echoes.  Returns the number of records touched."""
+        n = 0
+        for rec in self.records.values():
+            if peer_id in rec["peers"]:
+                rec["peers"].discard(peer_id)
+                n += 1
+        return n
+
     def clear_below(self, ledger_seq: int) -> None:
         cutoff = ledger_seq - FLOOD_RECORD_TTL_LEDGERS
-        for h in [h for h, r in self.records.items() if r["seq"] < cutoff]:
+        dead = [h for h, r in self.records.items() if r["seq"] < cutoff]
+        for h in dead:
             del self.records[h]
+        if dead and self.on_clear is not None:
+            self.on_clear(dead)
 
 
 class ItemTracker:
@@ -74,6 +100,11 @@ class OverlayManager:
         self.pending_peers: List = []
         self.authenticated: Dict[bytes, object] = {}
         self.floodgate = Floodgate()
+        # flood-propagation telemetry: retire tracked hop records when
+        # the floodgate GCs them (utils/floodtrace.py)
+        ft = getattr(app, "floodtracer", None)
+        if ft is not None:
+            self.floodgate.on_clear = ft.retire
         self.trackers: Dict[bytes, ItemTracker] = {}
         self.banned_peers: Set[bytes] = set()
         self.survey_manager = SurveyManager(app)
@@ -150,6 +181,15 @@ class OverlayManager:
                 self.peer_manager.on_connect_failure(*addr)
         if peer.peer_id and self.authenticated.get(peer.peer_id) is peer:
             del self.authenticated[peer.peer_id]
+            # per-connection flood state dies with the connection: the
+            # floodgate's have-sets and the tracker's per-link counters
+            # restart fresh on re-dial, so churn cannot inflate the
+            # dup-rate attribution or starve a reconnected peer of
+            # re-floods (see Floodgate.forget_peer)
+            self.floodgate.forget_peer(peer.peer_id)
+            ft = getattr(self.app, "floodtracer", None)
+            if ft is not None:
+                ft.forget_link(peer.peer_id.hex()[:8])
 
     def connection_count(self) -> int:
         return len(self.authenticated)
@@ -241,23 +281,36 @@ class OverlayManager:
         except Exception:
             return 0
 
-    def broadcast_message(self, msg, force: bool = False) -> None:
+    def broadcast_message(self, msg, force: bool = False,
+                          _kind: Optional[str] = None,
+                          _h: Optional[bytes] = None) -> None:
         """ref broadcastMessage :1038 — fan out to peers lacking it."""
-        for p in self.floodgate.peers_to_send(
-                msg, list(self.authenticated.values())):
+        h = _h if _h is not None else Floodgate.msg_id(msg)
+        ft = self.app.floodtracer
+        if ft.enabled and _kind is not None and \
+                h not in self.floodgate.records:
+            # fresh locally-originated item (broadcast_transaction /
+            # broadcast_scp before any flood record exists): hop zero
+            ft.note_origin(h, _kind, self._ledger_seq())
+        out = self.floodgate.peers_to_send(
+            msg, list(self.authenticated.values()), h=h)
+        if ft.enabled:
+            ft.note_forward(h, len(out))
+        for p in out:
             p.send_message(msg)
 
     def broadcast_transaction(self, env) -> None:
         self.broadcast_message(O.StellarMessage.make(
-            O.MessageType.TRANSACTION, env))
+            O.MessageType.TRANSACTION, env), _kind="tx")
 
     def broadcast_scp(self, scp_env) -> None:
         self.broadcast_message(O.StellarMessage.make(
-            O.MessageType.SCP_MESSAGE, scp_env))
+            O.MessageType.SCP_MESSAGE, scp_env), _kind="scp")
 
     # -- inbound dispatch (called from Peer) --------------------------------
 
-    def _note_flood(self, peer, new: bool) -> None:
+    def _note_flood(self, peer, new: bool, h: bytes, kind: str,
+                    seq: int) -> None:
         """Per-peer + aggregate flood-dedup attribution: which peer is
         feeding us fresh traffic vs redundant copies (the dedup hit
         rate the flood fan-out's efficiency shows up as)."""
@@ -270,6 +323,9 @@ class OverlayManager:
             peer.duplicate_flood_recv += 1
             peer.duplicate_flood_bytes += n
             self.app.metrics.counter("overlay.flood.duplicate").inc()
+        ft = self.app.floodtracer
+        if ft.enabled:
+            ft.note_recv(h, peer.peer_id.hex()[:8], new, kind, seq)
 
     def recv_transaction(self, peer, env) -> None:
         with self.app.tracer.span("overlay.recv.transaction"):
@@ -277,22 +333,24 @@ class OverlayManager:
             # admission work so recv->admit covers decode+validity+sigs
             recv_ts = self.app.txtracer.note_recv()
             msg = O.StellarMessage.make(O.MessageType.TRANSACTION, env)
-            new = self.floodgate.add_record(msg, peer.peer_id,
-                                            self._ledger_seq())
-            self._note_flood(peer, new)
+            h = Floodgate.msg_id(msg)
+            seq = self._ledger_seq()
+            new = self.floodgate.add_record(msg, peer.peer_id, seq, h=h)
+            self._note_flood(peer, new, h, "tx", seq)
             if not new:
                 return
             res = self.app.herder.tx_queue.try_add(env, recv_ts=recv_ts)
             if res == 0:  # pending: forward
-                self.broadcast_message(msg)
+                self.broadcast_message(msg, _h=h)
 
     def recv_scp_message(self, peer, scp_env) -> None:
         with self.app.tracer.span("overlay.recv.scp"):
             msg = O.StellarMessage.make(O.MessageType.SCP_MESSAGE,
                                         scp_env)
-            new = self.floodgate.add_record(msg, peer.peer_id,
-                                            self._ledger_seq())
-            self._note_flood(peer, new)
+            h = Floodgate.msg_id(msg)
+            seq = self._ledger_seq()
+            new = self.floodgate.add_record(msg, peer.peer_id, seq, h=h)
+            self._note_flood(peer, new, h, "scp", seq)
             if not new:
                 return
             # per-peer stale attribution: which peer keeps feeding
@@ -303,14 +361,14 @@ class OverlayManager:
                 peer.stale_scp_drops += 1
             if not self._sig_batching:
                 self.app.herder.recv_scp_envelope(scp_env)
-                self.broadcast_message(msg)
+                self.broadcast_message(msg, _h=h)
                 return
             # defer delivery to the end-of-crank drain so every peer's
             # envelopes this crank share one signature batch; forward
             # NOW (same as the direct path: forwarding never waited on
             # local verification)
             self._scp_inbox.append(scp_env)
-            self.broadcast_message(msg)
+            self.broadcast_message(msg, _h=h)
             if not self._scp_drain_posted:
                 self._scp_drain_posted = True
                 self.app.clock.post_action(self._drain_scp_inbox)

@@ -1,0 +1,167 @@
+"""NetworkObservatory: the fleet-merged view of a Simulation's telemetry.
+
+Single-node observability (flight recorder, tx lifecycle, vitals, the
+r19 flood hop records) answers "what did THIS node see"; the observatory
+joins every sim node's hop records and registries into the network-level
+questions the ROADMAP's multi-validator rungs need answered:
+
+- propagation: per flood item, which nodes saw it and when → time to
+  50%/90% node coverage, time-to-first-delivery off the origin;
+- redundancy: per directed link, unique vs duplicate arrivals → how much
+  of the flood fan-out is wasted bytes;
+- cadence: per-node close skew — who is lagging the network head.
+
+Everything is computed from virtual-clock stamps and deterministic
+counters, keys sorted and floats rounded, so a same-seed sim rerun
+yields a byte-identical ``json.dumps(snapshot(), sort_keys=True)`` —
+pinned by tests/test_observatory.py.
+
+Blind spot by design: hop records live under the flood tracker's stride
+gate, so under decimation an item's coverage is computed from the nodes
+that SAMPLED it, not all nodes that saw it (coverage counts are exact
+only while stride == 1).  Real-TCP fleets aggregate via
+tools/fleet_scrape.py instead — the observatory needs in-process access.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+
+def _p(xs: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile of an unsorted list; None when empty."""
+    if not xs:
+        return None
+    s = sorted(xs)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+def _summary(xs: List[float]) -> Optional[dict]:
+    if not xs:
+        return None
+    return {
+        "n": len(xs),
+        "p50": round(_p(xs, 0.50), 6),
+        "p90": round(_p(xs, 0.90), 6),
+        "max": round(max(xs), 6),
+    }
+
+
+class NetworkObservatory:
+    """Merges every sim node's flood hop records + registries into one
+    network view, served by the ``network-observatory`` admin endpoint
+    (the Simulation attaches ``app._observatory = self`` on every node,
+    restarts included)."""
+
+    def __init__(self, sim):
+        self.sim = sim
+
+    # -- merge ---------------------------------------------------------------
+
+    def _merged_items(self, alive: dict) -> Dict[str, dict]:
+        """hexhash -> merged per-item record across all alive nodes."""
+        items: Dict[str, dict] = {}
+        for nid, app in alive.items():
+            n8 = nid.hex()[:8]
+            for hexhash, rec in app.floodtracer.export().items():
+                it = items.setdefault(hexhash, {
+                    "kind": rec["kind"], "origin": None,
+                    "deliveries": [], "dups_total": 0})
+                if rec["origin"]:
+                    it["origin"] = n8
+                else:
+                    it["deliveries"].append(
+                        {"node": n8, "t": rec["first_t"],
+                         "from": rec["from"]})
+                it["dups_total"] += rec["dups"]
+                if rec["origin"]:
+                    # the origin "sees" the item at its own stamp too —
+                    # coverage counts it as node zero
+                    it["deliveries"].append(
+                        {"node": n8, "t": rec["first_t"], "from": None})
+        for it in items.values():
+            it["deliveries"].sort(key=lambda d: (d["t"], d["node"]))
+            for d in it["deliveries"]:
+                d["t"] = round(d["t"], 6)
+        return items
+
+    @staticmethod
+    def _coverage_times(it: dict, n_alive: int) -> dict:
+        """time-to-50%/90% node coverage + first-delivery lag for one
+        merged item, measured from the earliest stamp (the origin's when
+        the origin is known — it is always the earliest)."""
+        deliveries = it["deliveries"]
+        out = {"coverage": round(len(deliveries) / n_alive, 4)
+               if n_alive else 0.0, "t50": None, "t90": None, "ttfd": None}
+        if not deliveries:
+            return out
+        t0 = deliveries[0]["t"]
+        need50 = max(1, math.ceil(0.5 * n_alive))
+        need90 = max(1, math.ceil(0.9 * n_alive))
+        if len(deliveries) >= need50:
+            out["t50"] = round(deliveries[need50 - 1]["t"] - t0, 6)
+        if len(deliveries) >= need90:
+            out["t90"] = round(deliveries[need90 - 1]["t"] - t0, 6)
+        if it["origin"] is not None and len(deliveries) >= 2:
+            out["ttfd"] = round(deliveries[1]["t"] - t0, 6)
+        return out
+
+    # -- views ---------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        sim = self.sim
+        alive = sim.alive_nodes()
+        n_alive = len(alive)
+        items = self._merged_items(alive)
+
+        t50s, t90s, ttfds = [], [], []
+        item_docs = {}
+        for hexhash in sorted(items):
+            it = items[hexhash]
+            cov = self._coverage_times(it, n_alive)
+            if cov["t50"] is not None:
+                t50s.append(cov["t50"])
+            if cov["t90"] is not None:
+                t90s.append(cov["t90"])
+            if cov["ttfd"] is not None:
+                ttfds.append(cov["ttfd"])
+            item_docs[hexhash] = {
+                "kind": it["kind"], "origin": it["origin"],
+                "dups_total": it["dups_total"],
+                "deliveries": it["deliveries"], **cov}
+
+        links = {}
+        for nid in sorted(alive):
+            n8 = nid.hex()[:8]
+            for pid8, row in alive[nid].floodtracer.report(
+                    last=0)["links"].items():
+                links[f"{n8}<-{pid8}"] = {
+                    "unique": row["unique"],
+                    "duplicate": row["duplicate"],
+                    "redundancy": row["dup_ratio"],
+                }
+
+        lcls = {nid: app.ledger_manager.last_closed_seq()
+                for nid, app in alive.items()}
+        head = max(lcls.values()) if lcls else 0
+        cadence = {nid.hex()[:8]: {"lcl": seq, "lag": head - seq}
+                   for nid, seq in sorted(lcls.items())}
+
+        return {
+            "nodes": sorted(nid.hex()[:8] for nid in alive),
+            "n_items": len(item_docs),
+            "items": item_docs,
+            "propagation": {
+                "time_to_50pct": _summary(t50s),
+                "time_to_90pct": _summary(t90s),
+                "ttfd": _summary(ttfds),
+            },
+            "links": links,
+            "close_cadence": cadence,
+        }
+
+    def summary(self) -> dict:
+        """snapshot() minus the per-item detail — what benches persist."""
+        doc = self.snapshot()
+        del doc["items"]
+        return doc

@@ -45,6 +45,9 @@ class Simulation:
         # live loopback pairs: (a, b) -> (peer at a, peer at b)
         self.links: Dict[Tuple[bytes, bytes], tuple] = {}
         self.crashed: Dict[bytes, bool] = {}
+        # network observatory (attach_observatory); restart_node
+        # re-attaches it to rebuilt Applications
+        self.observatory = None
 
     # -- topology construction ---------------------------------------------
 
@@ -102,6 +105,8 @@ class Simulation:
     def _build_app(self, cfg: Config) -> Application:
         app = Application(self.clock, cfg)
         app.overlay_manager = OverlayManager(app)
+        if self.observatory is not None:
+            app._observatory = self.observatory
         return app
 
     def add_connection(self, a: bytes, b: bytes) -> None:
@@ -161,6 +166,39 @@ class Simulation:
     def alive_nodes(self) -> Dict[bytes, Application]:
         return {nid: app for nid, app in self.nodes.items()
                 if not self.crashed.get(nid)}
+
+    # -- observability rigs ---------------------------------------------------
+
+    def attach_observatory(self):
+        """Create (or return) the fleet-level NetworkObservatory and hang
+        it off every node as ``app._observatory`` so each node's
+        ``network-observatory`` admin endpoint serves the merged view.
+        Nodes rebuilt by ``restart_node`` re-attach automatically."""
+        if self.observatory is None:
+            from .observatory import NetworkObservatory
+
+            self.observatory = NetworkObservatory(self)
+        for app in self.nodes.values():
+            app._observatory = self.observatory
+        return self.observatory
+
+    def enable_crank_profiler(self):
+        """Arm the shared clock's wall-attribution profiler (fresh run:
+        re-enabling restarts the measurement window)."""
+        from ..utils.clock import CrankProfiler
+
+        self.clock.profiler = CrankProfiler()
+        self._profiler_v0 = self.clock.now()
+        return self.clock.profiler
+
+    def crank_report(self) -> Optional[dict]:
+        """The profiler's bucket report over the window since
+        ``enable_crank_profiler``, with wall-per-virtual-second."""
+        prof = self.clock.profiler
+        if prof is None:
+            return None
+        return prof.report(
+            virtual_elapsed=self.clock.now() - self._profiler_v0)
 
     # -- driving ------------------------------------------------------------
 

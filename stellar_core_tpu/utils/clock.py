@@ -25,6 +25,147 @@ class ClockMode(Enum):
     VIRTUAL_TIME = 1
 
 
+class CrankProfiler:
+    """Attributes the real wall time spent inside ``VirtualClock.crank``
+    dispatch to subsystem buckets, keyed by the callback's defining class
+    (the first ``__qualname__`` segment — closures armed inside a method
+    still carry the class).  Purely observational: it wraps each dispatch
+    in perf_counter stamps and never touches virtual time, so same-seed
+    sim runs stay bit-identical with the profiler on or off.
+
+    The ``crank`` bucket is the crank loop's own overhead (heap pops,
+    deadline scans, REAL_TIME idle sleeps) — whole-crank wall minus the
+    dispatched-callback wall — so the report's ``attributed_pct`` covers
+    everything spent inside crank(); the remainder of measured wall is
+    time outside the event loop (test harness, bench bookkeeping).
+    """
+
+    # first __qualname__ segment -> bucket; unlisted classes and
+    # module-level functions fall into "other"
+    _CLASS_BUCKETS = {
+        # quorum-slice evaluation + consensus state machines
+        "Herder": "consensus", "HerderSCPDriver": "consensus",
+        "PendingEnvelopes": "consensus", "SCP": "consensus",
+        "Slot": "consensus", "BallotProtocol": "consensus",
+        "NominationProtocol": "consensus", "LocalNode": "consensus",
+        "TallyEngine": "consensus", "QuorumTracker": "consensus",
+        "QuorumHealthMonitor": "consensus",
+        "TransactionQueue": "consensus",
+        # per-node close phases + state
+        "LedgerManager": "ledger", "ClosePipeline": "ledger",
+        "LedgerCloseData": "ledger", "BucketManager": "ledger",
+        "BucketList": "ledger", "Bucket": "ledger",
+        "HistoryManager": "ledger", "PublishWork": "ledger",
+        # overlay delivery
+        "OverlayManager": "overlay", "Peer": "overlay",
+        "LoopbackPeer": "overlay", "TCPPeer": "overlay",
+        "PeerDoor": "overlay", "TCPIOService": "overlay",
+        "Floodgate": "overlay", "SurveyManager": "overlay",
+        "PeerManager": "overlay", "AdminHttpServer": "overlay",
+        # chaos bookkeeping
+        "ChaosEngine": "chaos", "LinkChaos": "chaos",
+        "LinkPolicy": "chaos",
+        # rig machinery
+        "LoadGenerator": "loadgen",
+        "VitalsSampler": "vitals",
+        "Simulation": "sim",
+        "Application": "app",
+    }
+
+    def __init__(self):
+        self.buckets = {}  # bucket -> dispatched wall seconds
+        self.events = {}   # bucket -> dispatch count
+        self.crank_wall_s = 0.0
+        self.cranks = 0
+        self._t0 = _time.perf_counter()
+        self._qn_cache = {}  # qualname head -> bucket
+        # wall charged to nested scopes inside the current dispatch —
+        # subtracted from the enclosing charge so every wall second
+        # lands in exactly one bucket (self-time attribution)
+        self._nested = 0.0
+
+    def _bucket_of(self, cb) -> str:
+        qn = getattr(cb, "__qualname__", None)
+        if qn is None:  # functools.partial etc.
+            qn = getattr(getattr(cb, "func", None), "__qualname__", "")
+        head = qn.split(".", 1)[0]
+        b = self._qn_cache.get(head)
+        if b is None:
+            b = self._qn_cache[head] = self._CLASS_BUCKETS.get(
+                head, "other")
+        return b
+
+    def _charge_bucket(self, b: str, dt: float) -> None:
+        self.buckets[b] = self.buckets.get(b, 0.0) + dt
+        self.events[b] = self.events.get(b, 0) + 1
+
+    def run(self, cb: Callable[[], None]) -> None:
+        saved, self._nested = self._nested, 0.0
+        t0 = _time.perf_counter()
+        try:
+            cb()
+        finally:
+            dt = _time.perf_counter() - t0
+            self._charge_bucket(self._bucket_of(cb),
+                                max(0.0, dt - self._nested))
+            self._nested = saved
+
+    def run_timer(self, timer: "VirtualTimer") -> None:
+        cb = timer._cb  # snapshot: _fire() clears it
+        saved, self._nested = self._nested, 0.0
+        t0 = _time.perf_counter()
+        try:
+            timer._fire()
+        finally:
+            dt = _time.perf_counter() - t0
+            self._charge_bucket(self._bucket_of(cb),
+                                max(0.0, dt - self._nested))
+            self._nested = saved
+
+    # -- nested scopes (subsystem hooks) ------------------------------------
+    # Deep subsystems (ledger close, SCP envelope processing) run INSIDE
+    # overlay delivery callbacks, so entry-point attribution alone would
+    # lump them into "overlay".  scope_begin/scope_end carve their wall
+    # out of the enclosing dispatch; hook sites cost one is-None check
+    # when profiling is off and never read the wallclock themselves.
+
+    def scope_begin(self, bucket: str) -> tuple:
+        tok = (bucket, _time.perf_counter(), self._nested)
+        self._nested = 0.0
+        return tok
+
+    def scope_end(self, tok: tuple) -> None:
+        bucket, t0, saved = tok
+        dt = _time.perf_counter() - t0
+        self._charge_bucket(bucket, max(0.0, dt - self._nested))
+        self._nested = saved + dt
+
+    def note_crank(self, dt: float) -> None:
+        self.crank_wall_s += dt
+        self.cranks += 1
+
+    def report(self, virtual_elapsed: Optional[float] = None) -> dict:
+        measured = _time.perf_counter() - self._t0
+        dispatched = sum(self.buckets.values())
+        buckets = {k: round(v, 6) for k, v in sorted(self.buckets.items())}
+        buckets["crank"] = round(max(0.0, self.crank_wall_s - dispatched),
+                                 6)
+        attributed = dispatched + buckets["crank"]
+        doc = {
+            "buckets_s": buckets,
+            "events": {k: v for k, v in sorted(self.events.items())},
+            "cranks": self.cranks,
+            "measured_wall_s": round(measured, 6),
+            "attributed_wall_s": round(attributed, 6),
+            "attributed_pct": round(100.0 * attributed / measured, 2)
+            if measured > 0 else 0.0,
+        }
+        if virtual_elapsed is not None and virtual_elapsed > 0:
+            doc["virtual_s"] = round(virtual_elapsed, 6)
+            doc["wall_per_virtual_s"] = round(measured / virtual_elapsed, 6)
+        return doc
+
+
 class VirtualClock:
     def __init__(self, mode: ClockMode = ClockMode.VIRTUAL_TIME):
         self.mode = mode
@@ -33,6 +174,9 @@ class VirtualClock:
         self._seq = itertools.count()
         self._actions: List[Callable[[], None]] = []
         self._stopped = False
+        # crank wall-attribution hook (CrankProfiler); None keeps the
+        # dispatch loop at one is-None check per event
+        self.profiler: Optional[CrankProfiler] = None
 
     # -- time --------------------------------------------------------------
 
@@ -80,11 +224,16 @@ class VirtualClock:
         """
         if self._stopped:
             return 0
+        prof = self.profiler
+        t_start = _time.perf_counter() if prof is not None else 0.0
         progress = 0
 
         actions, self._actions = self._actions, []
         for a in actions:
-            a()
+            if prof is None:
+                a()
+            else:
+                prof.run(a)
             progress += 1
 
         while True:
@@ -102,13 +251,21 @@ class VirtualClock:
             _, _, timer, gen = heapq.heappop(self._timers)
             if not timer._live(gen):
                 continue
-            timer._fire()
+            if prof is None:
+                timer._fire()
+            else:
+                prof.run_timer(timer)
             progress += 1
             # actions posted by timer callbacks run this crank too
             actions, self._actions = self._actions, []
             for a in actions:
-                a()
+                if prof is None:
+                    a()
+                else:
+                    prof.run(a)
                 progress += 1
+        if prof is not None:
+            prof.note_crank(_time.perf_counter() - t_start)
         return progress
 
     def crank_until(self, pred: Callable[[], bool],

@@ -271,15 +271,51 @@ def _prom_name(name: str) -> str:
     return out
 
 
+#: registry families whose last dotted segment is a peer id (pid8 or the
+#: bounded_name "other" roll-up) — exposed as a {peer="..."} label
+#: instead of a per-peer metric name
+_PEER_FAMILIES = ("overlay.peer.", "floodtrace.link.")
+
+
+def _peer_split(name: str):
+    """'overlay.peer.queue_depth.ab12cd34' -> (family, member), else
+    None for names outside the per-peer families."""
+    for pref in _PEER_FAMILIES:
+        if name.startswith(pref):
+            fam, _, member = name.rpartition(".")
+            if fam != pref.rstrip(".") and member:
+                return fam, member
+    return None
+
+
 def render_prometheus(registry: MetricsRegistry) -> str:
     """Text exposition format (version 0.0.4) of the registry: counters
     as ``counter``, meters as count + 1m-rate gauge, timers/histograms
     as ``summary`` with quantile labels — the shape Prometheus's
     text-format parser and promtool both accept.  Span-derived timers
     (``span.*``, fed per close by the flight recorder) ride along as
-    ordinary registry timers."""
+    ordinary registry timers.
+
+    Per-peer families (overlay.peer.*, floodtrace.link.*) emit one
+    metric per family with a ``{peer="..."}`` label rather than
+    name-mangling the peer id — sorted iteration keeps a family's
+    members adjacent, so each family gets exactly one # TYPE line.  The
+    JSON snapshot() form is unchanged."""
     lines: List[str] = []
+    typed = set()
     for name, m in sorted(registry._metrics.items()):
+        ps = _peer_split(name) if isinstance(m, (Counter, Gauge)) else None
+        if ps is not None:
+            fam, member = ps
+            pname = _prom_name(fam)
+            if pname not in typed:
+                typed.add(pname)
+                lines.append(
+                    f"# TYPE {pname} "
+                    f"{'counter' if isinstance(m, Counter) else 'gauge'}")
+            val = m.count if isinstance(m, Counter) else f"{m.value:.6g}"
+            lines.append(f'{pname}{{peer="{member}"}} {val}')
+            continue
         pname = _prom_name(name)
         if isinstance(m, Counter):
             lines.append(f"# TYPE {pname} counter")

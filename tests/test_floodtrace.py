@@ -1,0 +1,227 @@
+"""Flood-propagation tracker (ISSUE 19 tentpole, layer 1): the
+deterministic sampling gate, bounded live map + retirement ring,
+per-link dedup attribution with the reconnect reset (satellite fix),
+and the end-to-end hop records a flooding sim actually produces."""
+import pytest
+
+from stellar_core_tpu.crypto import SecretKey, sha256
+from stellar_core_tpu.simulation import core
+from stellar_core_tpu.utils.floodtrace import FloodPropagationTracker
+from stellar_core_tpu.utils.metrics import MetricsRegistry
+
+from tests.test_simulation import _node_account, settle
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def now(self) -> float:
+        return self.t
+
+
+def _tracker(**kw):
+    clk = FakeClock()
+    ft = FloodPropagationTracker(metrics=MetricsRegistry(),
+                                 now=clk.now, **kw)
+    return ft, clk
+
+
+def _h(i: int) -> bytes:
+    return sha256(i.to_bytes(4, "big"))
+
+
+# ---------------------------------------------------------------------------
+# sampling gate + bounded memory
+# ---------------------------------------------------------------------------
+
+def test_identical_drive_produces_identical_exports():
+    """The determinism contract: hop records are a pure function of the
+    stamp sequence (no PRNG, no wallclock — the injected clock is the
+    only time source)."""
+    outs = []
+    for _ in range(2):
+        ft, clk = _tracker(max_live=8, ring=4)
+        for i in range(40):
+            clk.t += 0.25
+            ft.note_recv(_h(i), "aa" * 4, True, "tx", i)
+            ft.note_recv(_h(i), "bb" * 4, False, "tx", i)
+            ft.note_forward(_h(i), 3)
+        ft.retire([_h(i) for i in range(20)])
+        outs.append((ft.export(), ft.stats(),
+                     ft.report(last=8)))
+    assert outs[0] == outs[1]
+
+
+def test_decimation_bounds_live_map_and_doubles_stride():
+    ft, clk = _tracker(max_live=8)
+    for i in range(100):
+        clk.t += 0.1
+        ft.note_origin(_h(i), "tx", i)
+    st = ft.stats()
+    assert st["live"] < 8
+    assert st["stride"] > 1 and st["stride"] & (st["stride"] - 1) == 0
+    assert st["decimations"] >= 1
+    assert st["seen"] == 100
+    # the survivors are a systematic sample: re-driving the same
+    # sequence keeps the same survivor set
+    ft2, clk2 = _tracker(max_live=8)
+    for i in range(100):
+        clk2.t += 0.1
+        ft2.note_origin(_h(i), "tx", i)
+    assert sorted(ft.export()) == sorted(ft2.export())
+
+
+def test_retire_moves_records_to_ring_and_lookup_still_finds_them():
+    ft, clk = _tracker(max_live=64, ring=4)
+    for i in range(3):
+        clk.t += 1.0
+        ft.note_recv(_h(i), "aa" * 4, True, "tx", i)
+    ft.retire([_h(0), _h(1)])
+    st = ft.stats()
+    assert st["retired"] == 2 and st["live"] == 1
+    rec = ft.lookup(_h(0))
+    assert rec is not None and rec["hash"] == _h(0).hex()
+    # the ring is bounded: retiring more than maxlen drops the oldest
+    for i in range(3, 10):
+        clk.t += 1.0
+        ft.note_recv(_h(i), "aa" * 4, True, "tx", i)
+    ft.retire([_h(i) for i in range(3, 10)])
+    assert ft.lookup(_h(0)) is None  # evicted from the 4-deep ring
+    assert ft.lookup(_h(9)) is not None
+
+
+def test_disabled_tracker_is_inert():
+    ft, clk = _tracker()
+    ft.enabled = False
+    clk.t += 1.0
+    ft.note_recv(_h(1), "aa" * 4, True, "tx", 1)
+    ft.note_origin(_h(2), "tx", 1)
+    ft.note_forward(_h(1), 5)
+    ft.retire([_h(1)])
+    assert ft.export() == {}
+    assert ft.stats()["seen"] == 0
+    assert ft.metrics.snapshot() == {}
+
+
+# ---------------------------------------------------------------------------
+# duplicate attribution + the reconnect reset (satellite fix)
+# ---------------------------------------------------------------------------
+
+def test_duplicate_attribution_and_lag():
+    ft, clk = _tracker()
+    clk.t = 10.0
+    ft.note_recv(_h(1), "11" * 4, True, "tx", 5)
+    clk.t = 10.3
+    ft.note_recv(_h(1), "22" * 4, False, "tx", 5)
+    clk.t = 10.9
+    ft.note_recv(_h(1), "22" * 4, False, "tx", 5)
+    rec = ft.lookup(_h(1))
+    assert rec["from"] == "11" * 4 and rec["origin"] is False
+    assert rec["dups"] == 2
+    assert rec["dup_links"] == {"22" * 4: 2}
+    assert rec["dup_first_lag"] == pytest.approx(0.3)
+    links = ft.report(last=0)["links"]
+    assert links["11" * 4]["unique"] == 1
+    assert links["11" * 4]["dup_ratio"] == 0.0
+    assert links["22" * 4]["duplicate"] == 2
+    assert links["22" * 4]["dup_ratio"] == 1.0
+
+
+def test_forget_link_resets_per_connection_counters():
+    """The reconnect-churn fix: a link's unique/duplicate counters
+    describe the CURRENT connection only."""
+    ft, clk = _tracker()
+    for i in range(4):
+        clk.t += 1.0
+        ft.note_recv(_h(i), "aa" * 4, True, "tx", i)
+        ft.note_recv(_h(i), "aa" * 4, False, "tx", i)
+    assert ft.report(last=0)["links"]["aa" * 4]["unique"] == 4
+    ft.forget_link("aa" * 4)
+    links = ft.report(last=0)["links"]
+    assert links["aa" * 4]["unique"] == 0
+    assert links["aa" * 4]["duplicate"] == 0
+    # and the NEXT connection's traffic counts from zero, not four
+    clk.t += 1.0
+    ft.note_recv(_h(99), "aa" * 4, True, "tx", 9)
+    assert ft.report(last=0)["links"]["aa" * 4]["unique"] == 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a flooding sim writes hop records at every node
+# ---------------------------------------------------------------------------
+
+def _submit_create_account(app, salt: bytes):
+    root = _node_account(app, SecretKey(app.config.network_id()))
+    dest = SecretKey(sha256(salt))
+    env = root.tx([root.op_create_account(dest.public_key().raw, 10**9)])
+    assert app.herder.recv_transaction(env) == 0
+
+
+def test_sim_flood_produces_hop_records_network_wide():
+    sim = core(3, FLOOD_TRACE_ENABLED=True)
+    sim.start_all_nodes()
+    settle(sim)
+    apps = list(sim.nodes.values())
+    _submit_create_account(apps[0], b"floodtrace e2e")
+    settle(sim)
+
+    pid0 = apps[0].config.node_id().hex()[:8]
+    for app in apps[1:]:
+        recs = list(app.floodtracer.export().values())
+        tx_recs = [r for r in recs if r["kind"] == "tx"]
+        assert tx_recs, "relayed tx left no hop record"
+        rec = tx_recs[0]
+        assert rec["origin"] is False and rec["from"] is not None
+        # full mesh of 3: the second copy arrives as a duplicate
+        assert rec["dups"] >= 1
+    # the origin node records hop zero
+    origin_recs = [r for r in apps[0].floodtracer.export().values()
+                   if r["kind"] == "tx"]
+    assert origin_recs and origin_recs[0]["origin"] is True
+    assert origin_recs[0]["from"] is None
+    assert origin_recs[0]["fanout"] >= 2
+    # per-link attribution shows node 0 feeding at least one peer
+    fed = [app for app in apps[1:]
+           if pid0 in app.floodtracer.report(last=0)["links"]]
+    assert fed, "no peer attributes traffic to the origin's link"
+
+
+def test_peer_reconnect_resets_link_attribution_in_sim():
+    """Satellite fix, end-to-end: dropping a connection zeroes BOTH the
+    floodgate have-state and the tracker's per-link counters, so the
+    re-dialed link re-floods and its dup-rate attribution restarts."""
+    sim = core(3, FLOOD_TRACE_ENABLED=True)
+    sim.start_all_nodes()
+    settle(sim)
+    ids = list(sim.nodes)
+    apps = [sim.nodes[i] for i in ids]
+    pid0 = ids[0].hex()[:8]
+
+    _submit_create_account(apps[0], b"pre-reconnect")
+    settle(sim)
+    pre = apps[1].floodtracer.report(last=0)["links"].get(pid0, {})
+    assert pre.get("unique", 0) + pre.get("duplicate", 0) >= 1
+    # apply tx 1 so the root's seqnum advances for the second submit
+    assert sim.close_ledger()
+    settle(sim)
+
+    # drop the 0<->1 connection: both overlay managers run peer_closed
+    for p in sim.link_peers(ids[0], ids[1]):
+        p.close("test reconnect")
+    settle(sim)
+    links = apps[1].floodtracer.report(last=0)["links"]
+    assert links[pid0].get("unique", 0) == 0
+    assert links[pid0].get("duplicate", 0) == 0
+
+    # re-dial and flood again: the NEW connection counts from zero
+    sim.add_connection(ids[0], ids[1])
+    settle(sim)
+    assert apps[1].overlay_manager.connection_count() == 2
+    _submit_create_account(apps[0], b"post-reconnect")
+    settle(sim)
+    post = apps[1].floodtracer.report(last=0)["links"][pid0]
+    assert post.get("unique", 0) + post.get("duplicate", 0) >= 1
+    # the re-flood reached every node regardless of the churn
+    for app in apps:
+        assert app.herder.tx_queue.size() == 1

@@ -204,6 +204,42 @@ def test_gauge_snapshot_and_prometheus():
         reg.counter("vitals.rss_bytes")
 
 
+def test_prometheus_peer_label_round_trip():
+    """Per-peer families (ISSUE 19 satellite): overlay.peer.* and
+    floodtrace.link.* counters/gauges expose as one metric per family
+    with a {peer="..."} label, one TYPE line per family — while the
+    JSON snapshot keeps the dotted per-peer names byte-unchanged."""
+    reg = MetricsRegistry()
+    reg.counter("floodtrace.link.unique.ab12cd34").set_count(5)
+    reg.counter("floodtrace.link.unique.ff00ff00").set_count(2)
+    reg.counter("floodtrace.link.duplicate.ab12cd34").set_count(3)
+    reg.gauge("overlay.peer.queue_depth.ab12cd34").set(7)
+    reg.counter("overlay.peer.unique_recv.other").set_count(11)
+    reg.counter("overlay.flood.unique").set_count(9)  # outside the families
+    text = render_prometheus(reg)
+    samples, types = _parse(text)
+    assert samples["floodtrace_link_unique"]['{peer="ab12cd34"}'] == 5
+    assert samples["floodtrace_link_unique"]['{peer="ff00ff00"}'] == 2
+    assert samples["floodtrace_link_duplicate"]['{peer="ab12cd34"}'] == 3
+    assert types["floodtrace_link_unique"] == "counter"
+    assert samples["overlay_peer_queue_depth"]['{peer="ab12cd34"}'] == 7
+    assert types["overlay_peer_queue_depth"] == "gauge"
+    # the bounded_name roll-up member rides the same label
+    assert samples["overlay_peer_unique_recv"]['{peer="other"}'] == 11
+    # a name that merely STARTS with the family prefix but has no
+    # member segment stays unlabeled
+    assert samples["overlay_flood_unique"][""] == 9
+    # exactly one TYPE line per labeled family
+    lines = text.splitlines()
+    assert sum(1 for ln in lines
+               if ln == "# TYPE floodtrace_link_unique counter") == 1
+    # JSON snapshot keeps dotted names (byte-compat with pre-r19 JSON)
+    snap = reg.snapshot()
+    assert snap["floodtrace.link.unique.ab12cd34"] == \
+        {"type": "counter", "count": 5}
+    assert "floodtrace_link_unique" not in snap
+
+
 def test_every_rate1m_sample_has_a_gauge_type_line():
     """Every derived one-minute-rate sample (Meter AND Timer) must be
     preceded by its own `# TYPE ... gauge` declaration — a rate sample

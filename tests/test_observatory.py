@@ -1,0 +1,192 @@
+"""Network observatory (ISSUE 19 layers 2+3): fleet-merged propagation
+views, the same-seed determinism contract (byte-identical snapshots and
+hop records), crank wall attribution, and the tracing on/off
+consensus-inertness gate on a chaos scenario."""
+import json
+
+from stellar_core_tpu.crypto import SecretKey, sha256
+from stellar_core_tpu.simulation import core
+from stellar_core_tpu.simulation.chaos import run_standard_scenario
+
+from tests.test_simulation import _node_account, settle
+
+
+def _flooded_sim(trace_on: bool = True):
+    """core-3 sim with a tx flooded through consensus and two closes —
+    enough traffic for hop records, coverage and cadence views."""
+    sim = core(3, FLOOD_TRACE_ENABLED=trace_on)
+    sim.attach_observatory()
+    sim.start_all_nodes()
+    settle(sim)
+    app0 = list(sim.nodes.values())[0]
+    root = _node_account(app0, SecretKey(app0.config.network_id()))
+    dest = SecretKey(sha256(b"observatory"))
+    env = root.tx([root.op_create_account(dest.public_key().raw, 10**9)])
+    assert app0.herder.recv_transaction(env) == 0
+    settle(sim)
+    assert sim.close_ledger()
+    assert sim.close_ledger()
+    settle(sim)
+    return sim
+
+
+def test_observatory_snapshot_shape():
+    sim = _flooded_sim()
+    snap = sim.observatory.snapshot()
+    assert len(snap["nodes"]) == 3
+    assert snap["n_items"] >= 1
+    tx_items = [it for it in snap["items"].values()
+                if it["kind"] == "tx"]
+    assert tx_items, "the flooded tx never reached the merged view"
+    it = tx_items[0]
+    # full coverage on a healthy 3-mesh, with a known origin
+    assert it["coverage"] == 1.0
+    assert it["origin"] in snap["nodes"]
+    assert it["t50"] is not None and it["t90"] is not None
+    prop = snap["propagation"]
+    assert prop["time_to_90pct"] is not None
+    assert prop["time_to_90pct"]["n"] >= 1
+    # per-link redundancy rows are keyed node<-peer
+    assert snap["links"]
+    for key in snap["links"]:
+        to, _, frm = key.partition("<-")
+        assert to in snap["nodes"] and frm in snap["nodes"]
+    # every node reports a close cadence
+    assert sorted(snap["close_cadence"]) == snap["nodes"]
+    # summary() is snapshot() minus the per-item bulk
+    summ = sim.observatory.summary()
+    assert "items" not in summ
+    assert summ["propagation"] == prop
+
+
+def test_observatory_endpoint_serves_merged_view():
+    from stellar_core_tpu.main.http_server import CommandHandler
+
+    sim = _flooded_sim()
+    app = list(sim.nodes.values())[1]
+    status, body = CommandHandler(app).handle("network-observatory", {})
+    assert status == 200
+    assert body["observatory"]["n_items"] >= 1
+    # flood?hash= round-trips a merged item through one node's tracker
+    h = next(h for h, it in body["observatory"]["items"].items()
+             if it["kind"] == "tx")
+    served = [a for a in sim.nodes.values()
+              if CommandHandler(a).handle("flood", {"hash": h})[0] == 200]
+    assert served, "no node serves the flooded item's hop record"
+    rec = CommandHandler(served[0]).handle(
+        "flood", {"hash": h})[1]["flood"]
+    assert rec["hash"] == h
+    # a node without an observatory refuses with a pointer to the
+    # fleet-scrape path
+    app2 = list(sim.nodes.values())[0]
+    app2._observatory = None
+    assert CommandHandler(app2).handle(
+        "network-observatory", {})[0] == 400
+
+
+def test_same_seed_rerun_is_byte_identical():
+    """The determinism satellite: two identically-driven sims produce
+    byte-identical hop records AND observatory snapshots (virtual-clock
+    stamps, stride sampling and merge order are all deterministic)."""
+    blobs = []
+    for _ in range(2):
+        sim = _flooded_sim()
+        exports = {nid.hex()[:8]: app.floodtracer.export()
+                   for nid, app in sim.nodes.items()}
+        blobs.append((
+            json.dumps(sim.observatory.snapshot(), sort_keys=True),
+            json.dumps(exports, sort_keys=True)))
+    assert blobs[0][0] == blobs[1][0]
+    assert blobs[0][1] == blobs[1][1]
+
+
+def test_crank_profiler_attributes_sim_wall():
+    sim = core(3)
+    sim.attach_observatory()
+    sim.enable_crank_profiler()
+    sim.start_all_nodes()
+    settle(sim)
+    assert sim.close_ledger()
+    rep = sim.crank_report()
+    assert rep is not None
+    assert rep["cranks"] > 0
+    assert sum(rep["events"].values()) > 0
+    assert rep["measured_wall_s"] > 0
+    # a consensus round through the overlay touches all three planes
+    for bucket in ("overlay", "consensus", "ledger"):
+        assert rep["buckets_s"].get(bucket, 0.0) > 0.0, \
+            (bucket, rep["buckets_s"])
+    assert 0.0 <= rep["attributed_pct"] <= 100.0
+
+
+def test_fleet_scrape_socket_free(tmp_path):
+    """tools/fleet_scrape.py against injected fetchers: JSONL lines per
+    node per round, unreachable nodes quarantined, fleet roll-up math."""
+    from tools import fleet_scrape
+
+    docs = {
+        "n1:11626": {
+            "info": {"info": {"ledger": {"num": 42}}},
+            "metrics": {"metrics": {
+                "ledger.ledger.close": {"p50": 0.02, "count": 40},
+                "overlay.flood.unique": {"count": 30},
+                "overlay.flood.duplicate": {"count": 10}}},
+            "vitals": {"vitals": {"samples": 5}},
+            "flood?last=4": {"flood": {
+                "stride": 1, "tracked": 9, "live": 4, "retired": 5,
+                "links": {"ab12cd34": {"unique": 3, "duplicate": 1,
+                                       "dup_ratio": 0.25}}}},
+        },
+        "n2:11626": {
+            "info": {"info": {"ledger": {"num": 40}}},
+            "metrics": {"metrics": {
+                "overlay.flood.unique": {"count": 10},
+                "overlay.flood.duplicate": {"count": 10}}},
+        },
+    }
+
+    def fetch(base, path, timeout):
+        if base == "dead:1":
+            raise OSError("connection refused")
+        body = docs[base].get(path)
+        if body is None:
+            raise KeyError(path)
+        return body
+
+    out = tmp_path / "fleet.jsonl"
+    summary = fleet_scrape.run(
+        ["n1:11626", "n2:11626", "dead:1"], rounds=2, interval=0.0,
+        out_path=str(out), fetch=fetch, sleep=lambda s: None,
+        now=lambda: 1000.0)
+    lines = [json.loads(ln) for ln in out.read_text().splitlines()]
+    assert len(lines) == 2 * 3 + 1  # rounds x nodes + summary
+    assert lines[-1]["summary"] == summary
+    fleet = summary["fleet"]
+    assert fleet["n_reachable"] == 2
+    assert fleet["ledger_min"] == 40 and fleet["ledger_max"] == 42
+    assert fleet["ledger_spread"] == 2
+    assert fleet["flood_unique_total"] == 40
+    assert fleet["flood_redundancy"] == round(20 / 60, 4)
+    assert summary["unreachable"][0]["node"] == "dead:1"
+    n1 = summary["nodes"]["n1:11626"]
+    assert n1["close_p50_s"] == 0.02
+    assert n1["trace_stats"]["tracked"] == 9
+    assert summary["links"]["n1:11626<-ab12cd34"]["dup_ratio"] == 0.25
+    # vitals/flood failures are best-effort, not fatal
+    n2 = summary["nodes"]["n2:11626"]
+    assert n2["flood_unique"] == 10 and "links" not in n2
+
+
+def test_tracing_on_off_fingerprints_identical(tmp_path):
+    """Inertness on a chaos run: flood tracing on vs off must leave the
+    partition_heal scenario's per-node ledger-hash fingerprint
+    untouched (the full hashes+meta digest gate is the netobs bench)."""
+    fps = []
+    for d, on in (("on", True), ("off", False)):
+        rep = run_standard_scenario(
+            lambda: core(4, persist_dir=str(tmp_path / d),
+                         MANUAL_CLOSE=False, FLOOD_TRACE_ENABLED=on),
+            "partition_heal", seed=11, n_nodes=4, duration=12.0)
+        assert rep["fork_check"] == "pass"
+        fps.append(rep["fingerprint"])
+    assert fps[0] == fps[1]

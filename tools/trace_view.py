@@ -128,6 +128,110 @@ def render(trace: dict, width: int = 40, top: int = 10) -> str:
 
 
 # ---------------------------------------------------------------------------
+# propagation view (network observatory)
+# ---------------------------------------------------------------------------
+
+def _obs_body(doc: dict) -> dict:
+    """Accept a raw observatory snapshot, the `network-observatory`
+    endpoint body ({"observatory": ...}), or a NET_OBS bench tier doc
+    carrying an "observatory" key."""
+    if isinstance(doc.get("observatory"), dict):
+        return doc["observatory"]
+    return doc
+
+
+def render_propagation(doc: dict, item: Optional[str] = None,
+                       top: int = 8) -> str:
+    """Per-item hop tree + coverage timeline from a merged observatory
+    snapshot (browser-less).  ``item`` filters to hashes with that hex
+    prefix; otherwise the ``top`` most recent items render."""
+    snap = _obs_body(doc)
+    lines: List[str] = []
+    nodes = snap.get("nodes", [])
+    lines.append(f"{len(nodes)} nodes, {snap.get('n_items', 0)} "
+                 "flood items")
+    prop = snap.get("propagation", {})
+    for key in ("ttfd", "time_to_50pct", "time_to_90pct"):
+        s = prop.get(key)
+        if s:
+            lines.append(
+                f"  {key:<14} n={s['n']:<6} "
+                f"p50={s['p50'] * 1000.0:9.3f}ms "
+                f"p90={s['p90'] * 1000.0:9.3f}ms "
+                f"max={s['max'] * 1000.0:9.3f}ms")
+
+    items = snap.get("items", {})
+    sel = sorted(items.items(), key=lambda kv: (
+        kv[1]["deliveries"][0]["t"] if kv[1].get("deliveries") else 0.0,
+        kv[0]))
+    if item is not None:
+        sel = [(h, it) for h, it in sel if h.startswith(item)]
+    else:
+        sel = sel[-top:]
+
+    for h, it in sel:
+        lines.append("")
+        lines.append(
+            f"item {h[:16]} [{it.get('kind', '?')}] "
+            f"origin={it.get('origin') or '?'} "
+            f"coverage={it.get('coverage')} "
+            f"dups={it.get('dups_total', 0)}")
+        delv = it.get("deliveries", [])
+        if not delv:
+            continue
+        t0 = delv[0]["t"]
+        by_parent: Dict[Optional[str], List[dict]] = {}
+        node_set = {d["node"] for d in delv}
+        for d in delv:
+            by_parent.setdefault(d.get("from"), []).append(d)
+        emitted = set()
+
+        def walk(d: dict, depth: int) -> None:
+            if d["node"] in emitted:
+                return
+            emitted.add(d["node"])
+            mark = "*" if depth == 0 else "+"
+            src = f"  (from {d['from']})" \
+                if depth == 0 and d.get("from") else ""
+            lines.append(f"  {'  ' * depth}{mark} {d['node']} "
+                         f"+{(d['t'] - t0) * 1000.0:.3f}ms{src}")
+            for c in by_parent.get(d["node"], []):
+                walk(c, depth + 1)
+
+        for d in delv:
+            # roots: the origin (from=None) or deliveries whose sending
+            # peer has no record of its own (sampled out / evicted)
+            if d.get("from") is None or d["from"] not in node_set:
+                walk(d, 0)
+        for d in delv:  # anything the tree missed renders flat
+            walk(d, 0)
+        n = len(nodes) or len(delv)
+        steps = " ".join(
+            f"{i + 1}/{n}@{(d['t'] - t0) * 1000.0:.1f}ms"
+            for i, d in enumerate(delv))
+        lines.append(f"  coverage: {steps}")
+
+    links = snap.get("links", {})
+    if links:
+        lines.append("")
+        lines.append("link redundancy (dup / (uniq + dup)):")
+        for k in sorted(links):
+            row = links[k]
+            lines.append(f"  {k:<22} uniq={row['unique']:<7}"
+                         f"dup={row['duplicate']:<7}"
+                         f"r={row['redundancy']}")
+    cadence = snap.get("close_cadence", {})
+    if cadence:
+        lines.append("")
+        lines.append("close cadence (lcl, lag behind head):")
+        for n8 in sorted(cadence):
+            row = cadence[n8]
+            lines.append(f"  {n8:<10} lcl={row['lcl']:<8}"
+                         f"lag={row['lag']}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
 # slot-timeline view (consensus forensics)
 # ---------------------------------------------------------------------------
 
@@ -246,6 +350,12 @@ def main() -> int:
     ap.add_argument("--node", default=None,
                     help="with --slots: only nodes whose hex8 id "
                          "starts with this prefix")
+    ap.add_argument("--propagation", action="store_true",
+                    help="render per-item flood hop trees + coverage "
+                         "timelines from a network-observatory snapshot")
+    ap.add_argument("--item", default=None,
+                    help="with --propagation: only items whose hash "
+                         "starts with this hex prefix")
     args = ap.parse_args()
     try:
         with open(args.trace, encoding="utf-8") as f:
@@ -256,6 +366,8 @@ def main() -> int:
         return 2
     if args.slots:
         print(render_slots(trace, slot=args.slot, node=args.node))
+    elif args.propagation:
+        print(render_propagation(trace, item=args.item, top=args.top))
     else:
         print(render(trace, width=args.width, top=args.top))
     return 0

@@ -51,7 +51,13 @@ Usage: python tools/verify_green.py            -> exit 0 iff green
            core-2 net mid-traffic, catches up via verified bucket
            apply AND full replay, both ending bit-identical to the
            validators); --skip-lockdep-smoke skips the runtime
-           lockdep-witness gate.
+           lockdep-witness gate; --skip-netobs-smoke skips the
+           network-observatory gate (tools/chaos_bench.py --netobs
+           --tier core4: hop records nonzero, coverage percentiles
+           present, crank attribution >= 90%, tracing overhead < 2%,
+           on/off hash+meta inertness).
+       python tools/verify_green.py --netobs-smoke -> ONLY the
+           network-observatory gate above.
        python tools/verify_green.py --lockdep-smoke -> ONLY the
            runtime witness gate: the threaded-subsystem tier-1 subset,
            one core-4 chaos scenario and one pipelined-close bench
@@ -521,6 +527,57 @@ def run_soak_smoke() -> "tuple":
     return problems, summary
 
 
+def run_netobs_smoke() -> "tuple":
+    """The network-observatory gate (tools/chaos_bench.py --netobs
+    --tier core4): a core-4 sim under chaos + rate-mode loadgen with
+    flood tracing ON, then the same run with tracing OFF — nonzero hop
+    records, coverage percentiles present, crank wall attribution
+    >= 90%, tracing disabled-cost < 2% of close p50, and on/off
+    hash+meta inertness.  The tiered-50 tier is full-bench only
+    (NET_OBS_r19.json).  Returns (problems, summary)."""
+    out = "/tmp/_t1_netobs_smoke.json"
+    cmd = [sys.executable, "-m", "tools.chaos_bench", "--netobs",
+           "--tier", "core4", "--out", out]
+    print(f"verify_green: [netobs smoke] {' '.join(cmd)}", flush=True)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=600)
+    if proc.returncode != 0:
+        tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-6:])
+        return [f"netobs smoke exited {proc.returncode}: {tail}"], \
+            "failed"
+    try:
+        with open(out) as f:
+            rep = json.load(f)["tiers"]["core4"]
+    except (OSError, ValueError, KeyError) as e:
+        return [f"netobs smoke report unreadable: {e}"], "failed"
+    problems = []
+    g = rep.get("gates", {})
+    if not g.get("hop_records_nonzero"):
+        problems.append("netobs smoke: no flood hop records")
+    if not g.get("coverage_percentiles_present"):
+        problems.append("netobs smoke: coverage percentiles missing")
+    overhead = g.get("tracing_overhead_pct")
+    if not g.get("tracing_overhead_ok"):
+        problems.append(f"netobs smoke: tracing disabled-cost "
+                        f"{overhead}% of close p50 (gate: <2%)")
+    if not g.get("inert_hashes_and_meta"):
+        problems.append("netobs smoke: tracing on/off hash/meta "
+                        "parity DIVERGED")
+    if not g.get("attribution_ok"):
+        problems.append(f"netobs smoke: only {g.get('attributed_pct')}% "
+                        f"of crank wall attributed (gate: >=90%)")
+    prop = rep.get("on", {}).get("observatory", {}).get("propagation", {})
+    t90 = (prop.get("time_to_90pct") or {}).get("p90")
+    summary = (f"{rep.get('on', {}).get('hop_records_total')} hop "
+               f"records, t90 p90={t90}s, disabled-cost {overhead}% "
+               f"(enabled A/B {g.get('enabled_overhead_pct')}%), "
+               f"attributed {g.get('attributed_pct')}%, "
+               f"inert={'ok' if g.get('inert_hashes_and_meta') else 'FAILED'}")
+    return problems, summary
+
+
 #: threaded-subsystem tier-1 subset the lockdep witness re-runs: every
 #: file that exercises the pipelined close, the bucket background
 #: merge/GC, or a registered lock directly
@@ -714,6 +771,18 @@ def main() -> int:
         print("verify_green: LINT GREEN (detlint --strict clean)",
               flush=True)
         return 0
+    if "--netobs-smoke" in sys.argv:
+        # standalone network-observatory gate: core-4 chaos+load with
+        # flood tracing on/off, asserting the persisted r19 gates
+        no_problems, no_summary = run_netobs_smoke()
+        print(f"verify_green: netobs smoke: {no_summary}", flush=True)
+        if no_problems:
+            print(f"verify_green: RED ({'; '.join(no_problems)})",
+                  flush=True)
+            return 1
+        print(f"verify_green: GREEN (netobs smoke: {no_summary})",
+              flush=True)
+        return 0
     if "--lockdep-smoke" in sys.argv:
         # standalone runtime-witness gate: everything under LOCKDEP=1
         ld_problems, ld_summary = run_lockdep_smoke()
@@ -736,6 +805,7 @@ def main() -> int:
     skip_forensics = "--skip-forensics-smoke" in sys.argv
     skip_catchup = "--skip-catchup-smoke" in sys.argv
     skip_lockdep = "--skip-lockdep-smoke" in sys.argv
+    skip_netobs = "--skip-netobs-smoke" in sys.argv
     if smoke_only:
         cmd = tier1_command()
         problems, passed, summary = run_parallel_smoke(cmd)
@@ -842,6 +912,11 @@ def main() -> int:
         print(f"verify_green: catchup smoke: {cu_summary}", flush=True)
         problems.extend(cu_problems)
         smoke_note += f", catchup smoke: {cu_summary}"
+    if not skip_netobs:
+        no_problems, no_summary = run_netobs_smoke()
+        print(f"verify_green: netobs smoke: {no_summary}", flush=True)
+        problems.extend(no_problems)
+        smoke_note += f", netobs smoke: {no_summary}"
     if not skip_lockdep:
         ld_problems, ld_summary = run_lockdep_smoke()
         print(f"verify_green: lockdep smoke: {ld_summary}", flush=True)
