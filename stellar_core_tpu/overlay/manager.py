@@ -90,6 +90,7 @@ class ItemTracker:
         self.item_type = item_type  # GET_TX_SET or GET_SCP_QUORUMSET
         self.asked: Set[bytes] = set()
         self.dont_have: Set[bytes] = set()
+        self.tries = 0  # retry-timer firings (capped)
 
 
 class OverlayManager:
@@ -511,6 +512,18 @@ class OverlayManager:
 
     # -- anycast item fetch (ref ItemFetcher.h:54) ---------------------------
 
+    # ref Tracker.h MS_TO_WAIT_FOR_FETCH_REPLY: how long to wait for a
+    # fetch reply before asking the next peer.  Without the retry timer
+    # one dropped request or reply wedges the tracker — and with it the
+    # nomination waiting on the tx set — forever under lossy links (the
+    # fault-schedule fuzzer found exactly that: flaky links + traffic
+    # stalled a whole tiered network at one slot).
+    FETCH_RETRY_S = 2.0
+    # give up after this many retry firings (~1 virtual minute): a
+    # tracker nobody can answer must not pin a timer forever — any
+    # later envelope referencing the item starts a fresh fetch
+    MAX_FETCH_RETRIES = 32
+
     def fetch_items(self, hashes: List[bytes]) -> None:
         for h in hashes:
             if h in self.trackers:
@@ -520,6 +533,35 @@ class OverlayManager:
             tracker = ItemTracker(h, O.MessageType.GET_TX_SET)
             self.trackers[h] = tracker
             self._ask_next(tracker)
+            self._arm_fetch_retry(tracker)
+
+    def _arm_fetch_retry(self, tracker: ItemTracker) -> None:
+        """Re-ask for a still-missing item on a virtual-clock cadence
+        (ref Tracker::tryNextPeer).  When every connected peer has been
+        asked, the round-robin starts over — a peer that answered
+        DONT_HAVE (or dropped the request) may have the item by now."""
+        from ..utils.clock import VirtualTimer
+
+        timer = VirtualTimer(self.app.clock, owner=self.app)
+        timer.expires_from_now(self.FETCH_RETRY_S)
+
+        def fire() -> None:
+            if self._shutting_down or \
+                    self.trackers.get(tracker.item_hash) is not tracker:
+                return  # item arrived (or a fresh tracker took over)
+            tracker.tries += 1
+            if tracker.tries > self.MAX_FETCH_RETRIES:
+                del self.trackers[tracker.item_hash]
+                return
+            if all(p.peer_id in tracker.asked
+                   for p in self.authenticated.values()):
+                tracker.asked.clear()
+                tracker.dont_have.clear()
+            self.app.metrics.counter("overlay.fetch.retry").inc()
+            self._ask_next(tracker)
+            self._arm_fetch_retry(tracker)
+
+        timer.async_wait(fire)
 
     def _ask_next(self, tracker: ItemTracker) -> None:
         for p in self.authenticated.values():

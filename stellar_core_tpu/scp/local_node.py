@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Callable, Dict, FrozenSet, Iterable, List, Optional, Set
 
 from ..xdr import types as T, xdr_sha256
+from . import qset_vector
 
 
 def qset_hash(qset) -> bytes:
@@ -86,6 +87,15 @@ def is_quorum(
     whose qset has no slice inside the set; a non-empty fixpoint equal to the
     full contraction that also satisfies ``local_qset`` (when given) is a
     quorum.  Nodes with unknown qsets never count."""
+    if qset_vector._ENABLED and len(members) >= qset_vector._MIN_NODES:
+        # large member sets take the vectorized matrix-fixpoint path
+        # (scp/qset_vector.py) — exact integer math, bitwise-identical
+        # verdicts, with memo caches shared across every sim node in
+        # the process.  None means "not applicable" (a >2-level qset in
+        # play): fall through to the scalar oracle.
+        v = qset_vector.vector_is_quorum(members, get_qset, local_qset)
+        if v is not None:
+            return v
     cur = set(members)
     while True:
         # within one contraction step ``cur`` is fixed, so the slice

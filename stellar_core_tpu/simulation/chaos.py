@@ -56,6 +56,22 @@ from ..xdr import types as T
 from .simulation import Simulation
 
 
+class ScenarioFailure(AssertionError):
+    """Typed oracle failure raised by ``run_scenario``.
+
+    ``failure_class`` names WHICH oracle tripped (``fork``,
+    ``convergence-timeout``, ``unfired-script``, ``traffic``) — the
+    fuzzer's ddmin minimizer shrinks against the CLASS (same oracle
+    keeps failing) while the full failure fingerprint stays the
+    replay-identity check for the persisted repro."""
+
+    def __init__(self, failure_class: str, message: str,
+                 forensics_path: Optional[str] = None):
+        super().__init__(message)
+        self.failure_class = failure_class
+        self.forensics_path = forensics_path
+
+
 class LinkPolicy:
     """The engine's intended fault state for one (a, b) link; re-applied
     whenever link maintenance re-dials the pair."""
@@ -549,7 +565,7 @@ def run_induced_fork(make_sim: Callable[[], Simulation], seed: int,
     # reach quorum WITH the bridge, on the bridge's conflicting values
     chaos.equivocate(byz)
     sim.nodes[byz].overlay_manager.broadcast_message = \
-        lambda msg, force=False: None
+        lambda *a, **kw: None
     chaos.partition([[honest[0]], honest[1:]])
     clock = sim.clock
     t_end = clock.now() + duration
@@ -599,12 +615,154 @@ def _percentiles(values: List[float]) -> Dict[str, float]:
             "max": round(vs[-1], 3)}
 
 
+def _arm_traffic(sim: Simulation, chaos: ChaosEngine, traffic: List[dict],
+                 events: List[tuple], phase_reports: List[dict],
+                 label: str) -> tuple:
+    """Seed loadgen accounts THROUGH consensus, then append each
+    traffic phase to the scenario's event script (so phases share the
+    unfired-script oracle and the chaos event log with every other
+    fault).  Returns (LoadGenerator, merged events)."""
+    from ..ledger.ledger_txn import LedgerTxn
+    from .load_generator import LoadGenerator
+
+    phases = sorted(traffic, key=lambda p: p["t"])
+    prev_end = None
+    for p in phases:
+        assert p.get("mode", "pay") in ("pay", "pretend", "mixed"), p
+        if prev_end is not None:
+            assert p["t"] >= prev_end, \
+                f"[{label}] overlapping traffic phases: {phases}"
+        prev_end = p["t"] + p["duration"]
+
+    assert sim.crank_until(lambda: sim.have_all_externalized(2), 120.0), \
+        f"[{label}] network never started closing before traffic seeding"
+    app0 = sim.nodes[sorted(sim.nodes)[0]]
+    lg = LoadGenerator(app0)
+    n_accounts = max(8, max(int(p.get("accounts", 0)) for p in phases))
+    for env in lg.create_account_envelopes(n_accounts):
+        assert app0.herder.recv_transaction(env) == 0
+
+    def _applied(pub):
+        def probe():
+            with LedgerTxn(app0.ledger_manager.root) as ltx:
+                e = ltx.load_account(pub)
+                ltx.rollback()
+            return e is not None
+        return probe
+
+    assert sim.crank_until(
+        _applied(lg.accounts[-1].public_key().raw), 120.0), \
+        f"[{label}] loadgen account seeding stalled"
+
+    if any(p.get("mode") == "mixed" for p in phases):
+        # staged DEX seeding through consensus: issuer create in its
+        # own close, then trustlines, then funding (apply order inside
+        # one ledger is hash-shuffled, so each stage must land first)
+        for env in lg.create_dex_issuer_envelope():
+            assert app0.herder.recv_transaction(env) == 0
+        assert sim.crank_until(
+            _applied(lg.dex_issuer.public_key().raw), 120.0), \
+            f"[{label}] DEX issuer seeding stalled"
+        for env in lg.setup_dex_envelopes() + lg.fund_dex_envelopes():
+            assert app0.herder.recv_transaction(env) == 0
+        target = max(a.ledger_manager.last_closed_seq()
+                     for a in sim.alive_nodes().values()) + 2
+        assert sim.crank_until(
+            lambda: sim.have_all_externalized(target), 120.0), \
+            f"[{label}] DEX trustline seeding stalled"
+
+    # rate-mode generation batches land on the generator app's fair
+    # scheduler; a single-node rig drains it in Application.crank, but
+    # sim rigs crank the SHARED clock directly and never touch per-app
+    # schedulers — so traffic needs its own deterministic pump (a
+    # virtual timer, like every other scheduled piece of the scenario)
+    pump_timer = VirtualTimer(sim.clock, owner=chaos)
+
+    def pump():
+        if not sim.crashed.get(sorted(sim.nodes)[0], False):
+            while app0.scheduler.run_one():
+                pass
+        pump_timer.expires_from_now(0.5)
+        pump_timer.async_wait(pump)
+
+    pump()
+    chaos._timers.append(pump_timer)
+
+    def start_phase(c, p):
+        _flush_phase_report(lg, phase_reports)
+        lg.start_rate_run(
+            mode=p.get("mode", "pay"), rate=float(p["rate"]),
+            duration=float(p["duration"]),
+            dex_percent=int(p.get("dex_percent", 50)))
+
+    merged = list(events)
+    for p in phases:
+        elabel = (f"traffic {p.get('mode', 'pay')}@{p['rate']}tx/s "
+                  f"for {p['duration']}s")
+        merged.append((float(p["t"]), elabel,
+                       lambda c, p=p: start_phase(c, p)))
+    return lg, merged
+
+
+def _flush_phase_report(lg, phase_reports: List[dict]) -> None:
+    """Snapshot the finished rate run's accounting (one dict per
+    completed phase; resets the generator so the next flush can never
+    double-count)."""
+    st = lg.rate_status()
+    if "mode" in st:
+        phase_reports.append({
+            "mode": st["mode"], "rate": st["rate"],
+            "ticks": st["ticks"], "submitted": st["submitted"],
+            "status_counts": dict(sorted(st["status_counts"].items()))})
+    lg._rate_state = None
+
+
+def _traffic_oracle(sim: Simulation, traffic: List[dict],
+                    phase_reports: List[dict], label: str) -> dict:
+    """Traffic accounting contract: every phase started, and every
+    submitted tx carries a recorded admission status (the submit and
+    status counters increment together or the generator lost track of
+    a tx).  Returns the report's ``traffic`` section, including the
+    tx-queue overload counters — ban-set depth and aging/surge
+    admission statuses (TRY_AGAIN_LATER=3, BANNED=4) — that overload
+    scenarios assert against."""
+    assert len(phase_reports) == len(traffic), \
+        (f"[{label}] only {len(phase_reports)}/{len(traffic)} traffic "
+         f"phases ran — phase timers must fire inside the duration")
+    for rep in phase_reports:
+        assert rep["submitted"] == sum(rep["status_counts"].values()), \
+            f"[{label}] traffic accounting leak: {rep}"
+    submitted_total = sum(r["submitted"] for r in phase_reports)
+    expected = sum(float(p["rate"]) * float(p["duration"])
+                   for p in traffic)
+    if expected >= 2.0:
+        assert submitted_total > 0, \
+            (f"[{label}] traffic oracle: {expected:.0f} txs expected "
+             f"but none submitted")
+    queue = {"pending": 0, "banned": 0}
+    for app in sim.alive_nodes().values():
+        tq = app.herder.tx_queue
+        queue["pending"] += app.metrics.counter(
+            "herder.pending-txs.count").count
+        queue["banned"] += len(set().union(*tq.banned)) if tq.banned \
+            else 0
+    statuses: Dict[str, int] = {}
+    for rep in phase_reports:
+        for k, v in rep["status_counts"].items():
+            statuses[k] = statuses.get(k, 0) + v
+    return {"phases": phase_reports,
+            "submitted_total": submitted_total,
+            "status_totals": dict(sorted(statuses.items())),
+            "queue": queue}
+
+
 def run_scenario(make_sim: Callable[[], Simulation], seed: int,
                  events: List[Tuple[float, str,
                                     Callable[[ChaosEngine], None]]],
                  duration: float, label: str,
                  converge_timeout: float = 120.0,
-                 forensics_dir: Optional[str] = None) -> dict:
+                 forensics_dir: Optional[str] = None,
+                 traffic: Optional[List[dict]] = None) -> dict:
     """Run one scripted chaos scenario end to end and return its report.
 
     ``events`` is a list of (virtual-time offset, label, fn(chaos));
@@ -617,12 +775,27 @@ def run_scenario(make_sim: Callable[[], Simulation], seed: int,
     crash anywhere in a close raises out of the crank and fails the
     scenario — those are P0s, not statistics.
 
+    ``traffic`` makes loadgen rate mode a first-class scenario phase
+    (ROADMAP item 6: load running THROUGH the faults): a list of
+    ``{"t": offset, "duration": s, "mode": "pay"|"pretend"|"mixed",
+    "rate": tx/s, "dex_percent": int}`` dicts.  Before the fault window
+    the runner seeds generator accounts through real consensus (a
+    direct ledger write on a live network would itself be a fork), arms
+    each phase as a scripted event on one node's LoadGenerator, and the
+    report gains a ``traffic`` section: per-phase submit/status
+    accounting (asserted consistent: every submit has a recorded
+    admission status) plus the tx-queue overload counters (pending
+    depth, ban-set size, TRY_AGAIN_LATER/BANNED statuses — the aging
+    and surge-lane evidence).  Phases must not overlap: one generator
+    drives one rate run at a time.
+
     When any oracle FAILS (fork, convergence/heal timeout, unfired
-    script), the runner dumps the merged cross-node slot timeline with
-    first-divergence attribution to ``FORENSICS_*.json`` under
-    ``forensics_dir`` (cwd by default) and re-raises with the path —
-    a failing schedule becomes a readable timeline, not a
-    rerun-and-guess.
+    script, traffic accounting), the runner dumps the merged cross-node
+    slot timeline with first-divergence attribution to
+    ``FORENSICS_*.json`` under ``forensics_dir`` (cwd by default) and
+    raises ``ScenarioFailure`` with the oracle's ``failure_class`` and
+    the artifact path — a failing schedule becomes a readable timeline,
+    not a rerun-and-guess.
     """
     sim = make_sim()
     chaos = ChaosEngine(sim, seed)
@@ -631,6 +804,13 @@ def run_scenario(make_sim: Callable[[], Simulation], seed: int,
         pass  # handshakes settle at t=0
     chaos.start_maintenance()
     clock = sim.clock
+
+    lg = None
+    phase_reports: List[dict] = []
+    if traffic:
+        lg, events = _arm_traffic(sim, chaos, list(traffic),
+                                  list(events), phase_reports, label)
+
     t0 = clock.now()
     for offset, elabel, fn in events:
         t = VirtualTimer(clock, owner=chaos)
@@ -645,7 +825,8 @@ def run_scenario(make_sim: Callable[[], Simulation], seed: int,
                 clock.next_deadline() is None:
             break
 
-    def _oracle_failed(err: AssertionError) -> None:
+    def _oracle_failed(err: AssertionError,
+                       failure_class: str = "oracle") -> None:
         """Any failed oracle dumps the merged forensic timeline and
         re-raises with the artifact path attached."""
         chaos.stop()
@@ -656,7 +837,9 @@ def run_scenario(make_sim: Callable[[], Simulation], seed: int,
         finally:
             for nid in list(sim.alive_nodes()):
                 sim.nodes[nid].stop_node()
-        raise AssertionError(f"{err}\n[forensics] {path}") from None
+        raise ScenarioFailure(
+            failure_class, f"{err}\n[forensics] {path}",
+            forensics_path=path) from None
 
     # every scripted event must have fired inside the fault window — a
     # scenario whose script outlives its duration silently tests
@@ -668,10 +851,10 @@ def run_scenario(make_sim: Callable[[], Simulation], seed: int,
         _oracle_failed(AssertionError(
             f"[{label}] only {fired}/{len(events)} scripted events fired "
             f"within duration {duration}s — extend the duration to cover "
-            f"the script"))
+            f"the script"), "unfired-script")
 
     # clear every remaining fault and start the heal stopwatch
-    for nid in [n for n, dead in sim.crashed.items() if dead]:
+    for nid in sorted(n for n, dead in sim.crashed.items() if dead):
         chaos.restore(nid)
     chaos.heal()
     chaos.clear_links()
@@ -681,37 +864,62 @@ def run_scenario(make_sim: Callable[[], Simulation], seed: int,
     target = max(sim.nodes[n].ledger_manager.last_closed_seq()
                  for n in honest) + 2
 
-    def converged() -> bool:
-        hashes = set()
-        for nid in honest:
-            rec = chaos.extern_hashes.get(nid, {})
-            if target not in rec:
-                return False
-            hashes.add(rec[target])
-        return len(hashes) == 1
+    def converged_slot() -> Optional[int]:
+        """First slot >= target that EVERY honest survivor externalized
+        with one hash.  Any common slot counts, not just the target: a
+        node that rejoined through out-of-sync recovery catches up PAST
+        the target without re-externalizing it — a recording gap, not a
+        safety problem."""
+        recs = [chaos.extern_hashes.get(nid, {}) for nid in honest]
+        common = set(recs[0]) if recs else set()
+        for rec in recs[1:]:
+            common &= set(rec)
+        for s in sorted(x for x in common if x >= target):
+            if len({rec[s] for rec in recs}) == 1:
+                return s
+        return None
 
     deadline = heal_start + converge_timeout
-    while clock.now() < deadline and not converged():
+    while clock.now() < deadline and converged_slot() is None:
         if clock.crank(block=True) == 0 and \
                 clock.next_deadline() is None:
             break
-    if not converged():
+    conv = converged_slot()
+    if conv is None:
+        # a convergence timeout CAUSED by divergent histories is a
+        # fork, not a liveness problem — classify by the symptom's
+        # root so the fuzzer's ddmin matcher sees one stable class
+        div = first_hash_divergence(chaos)
         _oracle_failed(AssertionError(
             f"[{label}] honest survivors failed to converge on seq "
             f"{target} within {converge_timeout}s virtual: "
-            f"{[(n.hex()[:8], sim.nodes[n].ledger_manager.last_closed_seq()) for n in honest]}"))
-    # healed when the LAST honest node externalized the target seq
+            f"{[(n.hex()[:8], sim.nodes[n].ledger_manager.last_closed_seq()) for n in honest]}"
+            + (f" (diverged at slot {div['slot']})" if div else "")),
+            "fork" if div else "convergence-timeout")
+    # healed when the LAST honest node externalized the agreed slot
     time_to_heal = round(
         max(0.0, max(
-            chaos.extern_times[n][target][0] for n in honest
-            if target in chaos.extern_times.get(n, {})) - heal_start), 3)
+            chaos.extern_times[n][conv][0] for n in honest
+            if conv in chaos.extern_times.get(n, {})) - heal_start), 3)
     chaos.stop()
 
     # safety: full header-chain + bucket-hash agreement, all honest pairs
     try:
         fork_comparisons = sim.assert_no_forks(honest)
     except AssertionError as e:
-        _oracle_failed(e)
+        _oracle_failed(e, "fork")
+
+    # traffic accounting oracle: every phase must have started and
+    # every submitted tx must carry a recorded admission status
+    traffic_report = None
+    if traffic:
+        lg.stop_rate_run()
+        _flush_phase_report(lg, phase_reports)
+        try:
+            traffic_report = _traffic_oracle(
+                sim, traffic, phase_reports, label)
+        except AssertionError as e:
+            _oracle_failed(e, "traffic")
 
     # close-latency statistics over the whole run
     spread_ms: List[float] = []
@@ -742,6 +950,7 @@ def run_scenario(make_sim: Callable[[], Simulation], seed: int,
         "close_spread_virtual_ms": _percentiles(spread_ms),
         "round_wall_ms": _percentiles(wall_ms),
         "cadence_virtual_s": _percentiles(cadence_diffs),
+        "virtual_elapsed_s": round(clock.now() - t0, 3),
         "time_to_heal_s": time_to_heal,
         "counters": chaos.chaos_counters(),
         "fork_check": "pass",
@@ -758,6 +967,8 @@ def run_scenario(make_sim: Callable[[], Simulation], seed: int,
                                 chaos.extern_hashes[nid].items())}
             for nid in sorted(chaos.extern_hashes)},
     }
+    if traffic_report is not None:
+        report["traffic"] = traffic_report
     # release node resources (DB handles, pools) without stopping the
     # clock mid-assert; the sim object dies with this frame
     for nid in list(sim.alive_nodes()):

@@ -1,0 +1,361 @@
+"""Fault-schedule fuzzer tests: schedule IR determinism (in-process
+and across PYTHONHASHSEED subprocesses), validation/rejection
+hardening, ddmin minimization, repro artifacts, and — in the slow
+tier — the real known-bad fork end to end plus loadgen traffic as a
+first-class scenario phase."""
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from stellar_core_tpu.simulation.fuzz import schedule as S
+from stellar_core_tpu.simulation.fuzz.executor import (
+    novelty_signature, run_schedule)
+from stellar_core_tpu.simulation.fuzz.minimize import (
+    minimize_schedule, verify_repro, write_repro)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# schedule IR: generation determinism + canonical bytes
+# ---------------------------------------------------------------------------
+
+def test_generate_schedule_deterministic_in_process():
+    for seed in (0, 1, 7, 99):
+        a = S.generate_schedule(seed, "default")
+        b = S.generate_schedule(seed, "default")
+        assert S.canonical_bytes(a) == S.canonical_bytes(b)
+        assert S.schedule_id(a) == S.schedule_id(b)
+
+
+def test_generate_schedule_seeds_differ():
+    ids = {S.schedule_id(S.generate_schedule(s, "default"))
+           for s in range(12)}
+    assert len(ids) == 12, "seeds must explore distinct schedules"
+
+
+def test_generated_schedules_validate():
+    for profile in S.PROFILES:
+        for seed in range(15):
+            sched = S.generate_schedule(seed, profile)
+            S.validate_schedule(sched)  # must not raise
+            n = S.topology_size(sched["topology"])
+            ts = [e["t"] for e in sched["events"]]
+            assert ts == sorted(ts), "events must be time-ordered"
+            for e in sched["events"]:
+                for k in ("victim", "attacker"):
+                    if k in e:
+                        assert 0 <= e[k] < n
+                for g in e.get("groups", []):
+                    assert all(0 <= v < n for v in g)
+
+
+def test_schedule_bytes_stable_across_hashseed_subprocesses():
+    """The generator must be a pure function of the seed: canonical
+    schedule bytes identical under PYTHONHASHSEED=0 and 4242 (set-
+    iteration or dict-order leaks would diverge here)."""
+    prog = (
+        "from stellar_core_tpu.simulation.fuzz import schedule as S\n"
+        "from stellar_core_tpu.crypto import sha256\n"
+        "h = sha256(b''.join(S.canonical_bytes(\n"
+        "    S.generate_schedule(s, p))\n"
+        "    for p in sorted(S.PROFILES) for s in range(8)))\n"
+        "print(h.hex())\n")
+    digests = []
+    for hashseed in ("0", "4242"):
+        env = dict(os.environ, PYTHONHASHSEED=hashseed,
+                   JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-c", prog], cwd=REPO, env=env,
+            capture_output=True, text=True, timeout=180)
+        assert out.returncode == 0, out.stderr
+        digests.append(out.stdout.strip())
+    assert digests[0] == digests[1]
+
+
+# ---------------------------------------------------------------------------
+# validation + repro-file hardening
+# ---------------------------------------------------------------------------
+
+def test_validate_rejects_bad_schedules():
+    good = S.known_bad_schedule()
+    cases = [
+        ("unknown kind", lambda s: s["events"].append(
+            {"t": 1.0, "kind": "meteor-strike"})),
+        ("victim out of range", lambda s: s["events"].append(
+            {"t": 1.0, "kind": "crash", "victim": 99})),
+        ("negative time", lambda s: s["events"].append(
+            {"t": -1.0, "kind": "heal"})),
+        ("event past duration", lambda s: s["events"].append(
+            {"t": 1e9, "kind": "heal"})),
+        ("bad schema", lambda s: s.update(fuzz_schema=99)),
+        ("bad topology", lambda s: s.update(
+            topology={"kind": "torus", "n": 4})),
+        ("overlapping traffic", lambda s: s.update(traffic=[
+            {"t": 1.0, "duration": 5.0, "mode": "pay", "rate": 2.0},
+            {"t": 3.0, "duration": 5.0, "mode": "pay", "rate": 2.0}])),
+        ("bad traffic mode", lambda s: s.update(traffic=[
+            {"t": 1.0, "duration": 2.0, "mode": "ddos", "rate": 2.0}])),
+    ]
+    for what, mutate in cases:
+        sched = copy.deepcopy(good)
+        mutate(sched)
+        with pytest.raises(S.ScheduleError):
+            S.validate_schedule(sched)
+        assert what  # document intent
+
+
+def test_load_schedule_rejects_corrupted_file(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_bytes(b'{"fuzz_schema": 1, "seed": truncated')
+    with pytest.raises(S.ScheduleError, match="corrupted"):
+        S.load_schedule(str(p))
+    p.write_bytes(b"\xff\xfe not utf8 \x80")
+    with pytest.raises(S.ScheduleError, match="corrupted"):
+        S.load_schedule(str(p))
+
+
+def test_load_schedule_rejects_oversized_file(tmp_path):
+    p = tmp_path / "big.json"
+    p.write_bytes(b'{"pad": "' + b"A" * S.MAX_SCHEDULE_BYTES + b'"}')
+    with pytest.raises(S.ScheduleError, match="oversized"):
+        S.load_schedule(str(p))
+
+
+def test_load_schedule_rejects_invalid_schedule(tmp_path):
+    p = tmp_path / "invalid.json"
+    p.write_text(json.dumps({"fuzz_schema": 1, "seed": 1}))
+    with pytest.raises(S.ScheduleError):
+        S.load_schedule(str(p))
+
+
+def test_save_load_round_trip(tmp_path):
+    sched = S.generate_schedule(3, "smoke")
+    path = S.save_schedule(sched, str(tmp_path / "s.json"))
+    loaded = S.load_schedule(path)
+    assert S.canonical_bytes(loaded) == S.canonical_bytes(sched)
+
+
+# ---------------------------------------------------------------------------
+# ddmin + repro artifacts against a synthetic oracle (fast tier)
+# ---------------------------------------------------------------------------
+
+def _fake_run(sched):
+    """Synthetic oracle: 'forks' iff the equivocator AND the partition
+    both survive in the schedule — the minimal failing core the ddmin
+    must find under the chaff."""
+    kinds = [e["kind"] for e in sched.get("events", [])]
+    bad = "equivocate" in kinds and "partition" in kinds
+    fp = "fp-" + S.schedule_id(sched) if bad else None
+    return {"ok": not bad, "schedule_id": S.schedule_id(sched),
+            "failure_class": "fork" if bad else None,
+            "failure_fingerprint": fp,
+            "novelty": "n-" + S.schedule_id(sched),
+            "error": "synthetic fork" if bad else None}
+
+
+def test_ddmin_minimizes_known_bad_to_essentials():
+    kb = S.known_bad_schedule()  # 3 essential events + 4 chaff
+    assert len(kb["events"]) == 7
+    mini, stats = minimize_schedule(kb, run=_fake_run, max_runs=64)
+    kinds = sorted(e["kind"] for e in mini["events"])
+    assert kinds == ["equivocate", "partition"], \
+        f"ddmin left non-essential events: {mini['events']}"
+    assert stats["reproduces"] is True
+    assert stats["atoms_before"] == 7
+    assert stats["atoms_after"] == 2
+    assert stats["oracle_runs"] <= 64
+    # parameter shrinking kicked in: duration collapsed to the tail
+    assert mini["duration"] < kb["duration"]
+
+
+def test_minimize_rejects_passing_schedule():
+    sched = S.known_bad_schedule(noise=False)
+    sched["events"] = [{"t": 1.0, "kind": "heal"}]
+    with pytest.raises(ValueError, match="passes its oracles"):
+        minimize_schedule(sched, run=_fake_run, max_runs=8)
+
+
+def test_repro_round_trip_and_tamper_detection(tmp_path):
+    kb = S.known_bad_schedule(noise=False)
+    res = _fake_run(kb)
+    path = write_repro(kb, res, out_dir=str(tmp_path))
+    doc = S.load_schedule(path)
+    verdict = verify_repro(doc, run=_fake_run)
+    assert verdict["reproduced"] is True
+    # a tampered expectation must fail closed
+    doc["expect"]["failure_fingerprint"] = "0" * 64
+    assert verify_repro(doc, run=_fake_run)["reproduced"] is False
+    # unknown repro schema is rejected, not guessed at
+    doc["fuzz_repro_schema"] = 99
+    with pytest.raises(S.ScheduleError):
+        verify_repro(doc, run=_fake_run)
+
+
+def test_novelty_signature_quantizes():
+    sched = S.known_bad_schedule()
+    a = {"ok": True, "failure_class": None,
+         "report": {"ledgers_closed": 12, "time_to_heal_s": 3.1,
+                    "counters": {"drops": 4}}}
+    b = {"ok": True, "failure_class": None,
+         "report": {"ledgers_closed": 13, "time_to_heal_s": 3.4,
+                    "counters": {"drops": 9}}}
+    c = {"ok": False, "failure_class": "fork",
+         "report": {"ledgers_closed": 12, "time_to_heal_s": 3.1,
+                    "counters": {"drops": 4}}}
+    assert novelty_signature(sched, a) == novelty_signature(sched, b), \
+        "near-identical behavior must collide"
+    assert novelty_signature(sched, a) != novelty_signature(sched, c), \
+        "a failure is always novel against a pass"
+
+
+# ---------------------------------------------------------------------------
+# real-executor tier (slow): the known-bad fork, replay identity,
+# and traffic as a first-class scenario phase
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_known_bad_forks_and_minimizes_for_real(tmp_path):
+    kb = S.known_bad_schedule()
+    first = run_schedule(kb)
+    assert first["ok"] is False
+    assert first["failure_class"] == "fork"
+    # same-seed rerun: identical failure fingerprint (replay identity)
+    again = run_schedule(kb)
+    assert again["failure_fingerprint"] == first["failure_fingerprint"]
+    mini, stats = minimize_schedule(
+        kb, target_class="fork", max_runs=32)
+    assert stats["reproduces"] is True
+    kinds = sorted(e["kind"] for e in mini["events"])
+    assert kinds == ["equivocate", "partition", "silence"], kinds
+    path = write_repro(mini, dict(stats["final_result"], ok=False),
+                       out_dir=str(tmp_path),
+                       minimized_from=S.schedule_id(kb))
+    verdict = verify_repro(S.load_schedule(path))
+    assert verdict["reproduced"] is True
+
+
+@pytest.mark.slow
+def test_run_fingerprint_stable_across_hashseed_subprocesses():
+    """The executor's failure fingerprint is a pure function of the
+    schedule: two fresh processes with different PYTHONHASHSEED values
+    must reproduce it byte-for-byte."""
+    prog = (
+        "import json\n"
+        "from stellar_core_tpu.simulation.fuzz import schedule as S\n"
+        "from stellar_core_tpu.simulation.fuzz.executor "
+        "import run_schedule\n"
+        "kb = S.known_bad_schedule(noise=False)\n"
+        "kb['duration'] = 6.0\n"
+        "r = run_schedule(kb)\n"
+        "print(json.dumps({'class': r['failure_class'],\n"
+        "                  'fp': r['failure_fingerprint']}))\n")
+    rows = []
+    for hashseed in ("0", "4242"):
+        env = dict(os.environ, PYTHONHASHSEED=hashseed,
+                   JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-c", prog], cwd=REPO, env=env,
+            capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr[-2000:]
+        rows.append(json.loads(out.stdout.strip().splitlines()[-1]))
+    assert rows[0]["class"] == "fork"
+    assert rows[0] == rows[1]
+
+
+@pytest.mark.slow
+def test_traffic_phase_is_first_class_scenario_event():
+    """Loadgen rate mode composes with chaos inside run_scenario: the
+    schedule's traffic phase runs THROUGH a lag fault, and the traffic
+    oracle's accounting (every submit has an admission status; queue
+    counters surfaced) holds."""
+    sched = {
+        "fuzz_schema": S.SCHEMA_VERSION,
+        "seed": 5,
+        "profile": "test",
+        "topology": {"kind": "core", "n": 4},
+        "duration": 12.0,
+        "converge_timeout": 60.0,
+        "events": [
+            {"t": 2.0, "kind": "lag", "victim": 2, "latency": 0.3},
+            {"t": 8.0, "kind": "unlag", "victim": 2},
+        ],
+        "traffic": [
+            {"t": 1.0, "duration": 8.0, "mode": "pay", "rate": 4.0},
+        ],
+    }
+    S.validate_schedule(sched)
+    res = run_schedule(sched)
+    assert res["ok"], res.get("error")
+    traffic = res["report"]["traffic"]
+    assert len(traffic["phases"]) == 1
+    phase = traffic["phases"][0]
+    assert phase["submitted"] > 0
+    assert phase["submitted"] == sum(phase["status_counts"].values())
+    assert traffic["submitted_total"] == phase["submitted"]
+    # tx-queue overload counters are surfaced (aging/surge evidence)
+    assert set(traffic["queue"]) == {"pending", "banned"}
+    # same-seed rerun reproduces the run fingerprint, traffic included
+    res2 = run_schedule(sched)
+    assert res2["fingerprint"] == res["fingerprint"]
+
+
+# -- the real finding's fix: item-fetch retry -------------------------------
+
+
+def test_fetch_retry_survives_dropped_request():
+    """Regression for the fuzzer's first real catch (smoke seed 9002):
+    flaky links + traffic wedged a whole tiered network at one slot
+    because a dropped GET_TX_SET request (or reply) stalled its
+    ItemTracker forever — fetch_items asked ONE peer and only advanced
+    on an explicit DONT_HAVE.  The fix is the reference's
+    Tracker::tryNextPeer retry timer: re-ask on a virtual-clock
+    cadence, wrap around when every peer has been asked, give up only
+    after MAX_FETCH_RETRIES (later envelopes restart the fetch)."""
+    from types import SimpleNamespace
+
+    from stellar_core_tpu.overlay.manager import OverlayManager
+    from stellar_core_tpu.utils.clock import VirtualClock
+    from stellar_core_tpu.utils.metrics import MetricsRegistry
+
+    clock = VirtualClock()
+    app = SimpleNamespace(clock=clock, metrics=MetricsRegistry(),
+                          floodtracer=None, database=None,
+                          config=SimpleNamespace())
+    om = OverlayManager(app)
+    sent = []
+    peer = SimpleNamespace(peer_id=b"\x01" * 32,
+                           send_message=lambda m: sent.append(m))
+    om.authenticated[peer.peer_id] = peer
+    h = b"\x77" * 32
+
+    om.fetch_items([h])
+    first_ask = len(sent)
+    assert first_ask == 2  # GET_TX_SET + GET_SCP_QUORUMSET (both lost)
+
+    # the wire dropped everything: the retry timer must re-ask
+    clock.crank_until(lambda: False, timeout=3 * om.FETCH_RETRY_S)
+    retries = app.metrics.counter("overlay.fetch.retry").count
+    assert retries >= 2
+    assert len(sent) > first_ask, "retry never re-asked the peer"
+    assert h in om.trackers
+
+    # the item finally arrives: the tracker dies and the timer goes
+    # quiet (no further asks, counter frozen)
+    om.trackers.pop(h)
+    quiet0 = len(sent)
+    clock.crank_until(lambda: False, timeout=3 * om.FETCH_RETRY_S)
+    assert len(sent) == quiet0
+    assert app.metrics.counter("overlay.fetch.retry").count == retries
+
+    # an unanswerable item gives up after the cap instead of pinning a
+    # timer forever
+    om.fetch_items([h])
+    clock.crank_until(
+        lambda: h not in om.trackers,
+        timeout=(om.MAX_FETCH_RETRIES + 2) * om.FETCH_RETRY_S)
+    assert h not in om.trackers

@@ -1,0 +1,148 @@
+"""Differential tests for the vectorized quorum evaluator
+(scp/qset_vector.py): bitwise-identical verdicts against the scalar
+oracle, the deep-qset fallback, cross-call memo sharing, and the kill
+switch."""
+import random
+
+import pytest
+
+from stellar_core_tpu.scp import local_node as LN
+from stellar_core_tpu.scp import qset_vector
+
+
+def _ids(n):
+    return [bytes([i]) * 32 for i in range(n)]
+
+
+@pytest.fixture(autouse=True)
+def _vector_state():
+    """Force the vector path on (min 2 nodes) and restore everything."""
+    qset_vector.clear_caches()
+    old_enabled = qset_vector.set_enabled(True)
+    old_min = qset_vector.set_min_nodes(2)
+    yield
+    qset_vector.set_enabled(old_enabled)
+    qset_vector.set_min_nodes(old_min)
+    qset_vector.clear_caches()
+
+
+def _scalar_is_quorum(members, get_qset, local_qset=None):
+    old = qset_vector.set_enabled(False)
+    try:
+        return LN.is_quorum(members, get_qset, local_qset=local_qset)
+    finally:
+        qset_vector.set_enabled(old)
+
+
+def _random_qset(rng, ids):
+    """A random 2-level qset over a subset of ids."""
+    pool = rng.sample(ids, rng.randint(2, len(ids)))
+    n_inner = rng.randint(0, 2)
+    inner = []
+    for _ in range(n_inner):
+        members = rng.sample(ids, rng.randint(1, 4))
+        inner.append(LN.make_qset(
+            rng.randint(1, len(members)), members))
+    split = rng.randint(0, len(pool))
+    top = pool[:split]
+    thr = rng.randint(1, max(1, len(top) + len(inner)))
+    return LN.make_qset(thr, top, inner)
+
+
+def test_differential_random_qsets():
+    """400 random member-set/qset-map trials: the vector path must be
+    verdict-identical to the scalar oracle, including unknown qsets
+    and a local_qset check."""
+    rng = random.Random(1234)
+    ids = _ids(16)
+    mismatches = 0
+    for trial in range(400):
+        qsets = {}
+        shared = _random_qset(rng, ids)
+        for nid in ids:
+            if rng.random() < 0.1:
+                qsets[nid] = None  # unknown qset
+            elif rng.random() < 0.6:
+                qsets[nid] = shared  # realistic: most nodes share one
+            else:
+                qsets[nid] = _random_qset(rng, ids)
+        members = set(rng.sample(ids, rng.randint(2, len(ids))))
+        local = shared if rng.random() < 0.5 else None
+        get_qset = qsets.get
+        want = _scalar_is_quorum(members, get_qset, local)
+        got = LN.is_quorum(members, get_qset, local_qset=local)
+        assert got == want, (
+            f"trial {trial}: vector={got} scalar={want}")
+    assert mismatches == 0
+    # the vector path actually ran (not everything fell back)
+    assert qset_vector.stats["verdict_misses"] > 0
+
+
+def test_deep_qset_falls_back_to_scalar():
+    """A 3-level qset is outside the vectorized shape: the fast path
+    must return None (fallback), and is_quorum must still be right."""
+    ids = _ids(6)
+    innermost = LN.make_qset(1, ids[4:6])
+    inner = LN.make_qset(1, [], [innermost])
+    deep = LN.make_qset(2, ids[0:2], [inner])
+    get_qset = {nid: deep for nid in ids}.get
+    assert qset_vector.vector_is_quorum(
+        set(ids), get_qset, None) is None
+    assert LN.is_quorum(set(ids), get_qset) == \
+        _scalar_is_quorum(set(ids), get_qset)
+    assert qset_vector.stats["fallback_deep"] > 0
+
+
+def test_memo_sharing_across_calls():
+    """Two nodes evaluating the same vote set reuse one verdict; a
+    structurally-equal but distinct qset object reuses the same pack
+    (the cross-node sharing the module exists for)."""
+    ids = _ids(8)
+    q1 = LN.make_qset(5, ids)
+    q2 = LN.make_qset(5, ids)  # equal structure, different object
+    members = set(ids[:6])
+    LN.is_quorum(members, {nid: q1 for nid in ids}.get)
+    misses0 = qset_vector.stats["verdict_misses"]
+    packs0 = qset_vector.stats["pack_builds"]
+    hits0 = qset_vector.stats["verdict_hits"]
+    LN.is_quorum(members, {nid: q2 for nid in ids}.get)
+    assert qset_vector.stats["verdict_hits"] == hits0 + 1
+    assert qset_vector.stats["verdict_misses"] == misses0
+    assert qset_vector.stats["pack_builds"] == packs0
+
+
+def test_kill_switch_and_min_nodes():
+    ids = _ids(8)
+    q = LN.make_qset(5, ids)
+    get_qset = {nid: q for nid in ids}.get
+    members = set(ids)
+    qset_vector.set_enabled(False)
+    calls0 = qset_vector.stats["calls"]
+    assert LN.is_quorum(members, get_qset) is True
+    assert qset_vector.stats["calls"] == calls0  # never entered
+    qset_vector.set_enabled(True)
+    qset_vector.set_min_nodes(100)  # small sets stay scalar
+    assert LN.is_quorum(members, get_qset) is True
+    assert qset_vector.stats["calls"] == calls0
+    qset_vector.set_min_nodes(2)
+    assert LN.is_quorum(members, get_qset) is True
+    assert qset_vector.stats["calls"] == calls0 + 1
+
+
+def test_tiered_topology_shape():
+    """The hierarchical_quorum shape (orgs as inner sets, empty top
+    validators) — the fleet fuzzing workload — stays exact at 50
+    validators, including v-blocking-style partial member sets."""
+    rng = random.Random(7)
+    n_orgs, per_org = 10, 5
+    ids = _ids(n_orgs * per_org)
+    orgs = [ids[o * per_org:(o + 1) * per_org] for o in range(n_orgs)]
+    inner = [LN.make_qset(per_org - (per_org - 1) // 3, members)
+             for members in orgs]
+    qset = LN.make_qset(n_orgs - (n_orgs - 1) // 3, [], inner)
+    get_qset = {nid: qset for nid in ids}.get
+    for _ in range(25):
+        members = set(rng.sample(ids, rng.randint(10, len(ids))))
+        want = _scalar_is_quorum(members, get_qset, qset)
+        got = LN.is_quorum(members, get_qset, local_qset=qset)
+        assert got == want

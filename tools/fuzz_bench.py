@@ -1,0 +1,369 @@
+#!/usr/bin/env python
+"""Fuzz-campaign bench: throughput, fleet scale, and the vectorized
+quorum A/B — the evidence file for the seeded fault-schedule fuzzer.
+
+Persists ``FUZZ_BENCH_r20.json`` with:
+
+- campaign throughput (schedules/hour) and corpus/novelty stats for
+  the ``smoke`` and ``default`` generation profiles,
+- fleet-scale runs: one generated ``fleet``-profile schedule at 50 and
+  at 100 validators, reporting wall seconds per virtual second,
+- the vectorized-vs-scalar quorum A/B: the SAME slice-evaluation
+  workload (every node of a tiered network evaluating ``is_quorum``
+  over drifting vote sets, exactly the per-slot shape SCP produces)
+  timed in one session with ``scp/qset_vector`` enabled then disabled
+  — the acceptance gate wants >= 2x at 50+ validators,
+- the known-bad proof: the injected fork schedule is found (fails),
+  ddmin-minimized to its essential events, persisted to ``traces/``,
+  and the artifact replays to the same failure fingerprint.
+
+Usage:
+    python -m tools.fuzz_bench                  # full bench (~10 min)
+    python -m tools.fuzz_bench --smoke --out /tmp/fuzz_smoke.json
+    python -m tools.fuzz_bench --skip-fleet     # skip 50/100-validator runs
+
+``--smoke`` is the verify_green gate: a budget-capped campaign on the
+smoke profile (core-4 + one tiered net), the known-bad minimize +
+replay proof, and a reduced A/B — red (exit 1) on any oracle failure
+other than schedules that legitimately reproduce, or on a
+non-reproducing minimized artifact.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stellar_core_tpu.scp import local_node as LN  # noqa: E402
+from stellar_core_tpu.scp import qset_vector  # noqa: E402
+from stellar_core_tpu.simulation.fuzz import (  # noqa: E402
+    FuzzCampaign, known_bad_schedule, load_schedule, minimize_schedule,
+    run_schedule, schedule_id, write_repro,
+)
+from stellar_core_tpu.simulation.fuzz import schedule as S  # noqa: E402
+from stellar_core_tpu.simulation.fuzz.minimize import verify_repro  # noqa: E402
+from stellar_core_tpu.simulation.simulation import _ids, _seeds  # noqa: E402
+
+OUT = "FUZZ_BENCH_r20.json"
+
+
+# ---------------------------------------------------------------------------
+# vectorized-vs-scalar slice-evaluation A/B
+# ---------------------------------------------------------------------------
+
+def _tiered_qsets(n_orgs: int, per_org: int):
+    """Per-node hierarchical_quorum qsets: each validator owns its OWN
+    qset object with the same symmetric structure — exactly what
+    ``Simulation.add_node`` + ``Slot.qset_from_statement`` produce (a
+    node resolves every matching statement hash to its own cached
+    object, so objects are uniform within a call but distinct across
+    nodes)."""
+    ids = _ids(_seeds(n_orgs * per_org))
+    orgs = [ids[o * per_org:(o + 1) * per_org] for o in range(n_orgs)]
+
+    def mk():
+        inner = [LN.make_qset(per_org - (per_org - 1) // 3, members)
+                 for members in orgs]
+        return LN.make_qset(n_orgs - (n_orgs - 1) // 3, [], inner)
+
+    return ids, {nid: mk() for nid in ids}
+
+
+def bench_slice_eval(n_orgs: int, per_org: int, rounds: int = 40) -> dict:
+    """Time the per-slot quorum workload: each round drifts the vote
+    set (an org is late, then shows up), then EVERY node evaluates
+    ``is_quorum`` over it with its own qset objects — N evaluations of
+    the same member set per phase, the exact shape
+    ``Slot._host_is_quorum`` produces across a sim's nodes within one
+    slot.
+
+    Each arm gets one untimed warm-up pass: the A/B compares
+    steady-state cost, which is what a schedule pays — a run closes
+    hundreds of slots after the first crank has warmed the memos (the
+    scalar arm has no cross-call caches, so warm-up only levels the
+    field)."""
+    n = n_orgs * per_org
+    ids, qsets = _tiered_qsets(n_orgs, per_org)
+
+    def workload() -> int:
+        verdicts = 0
+        for r in range(rounds):
+            # drifting membership: a rotating org is late, then shows up
+            absent = set(ids[(r % n_orgs) * per_org:
+                             (r % n_orgs) * per_org + per_org])
+            for grow in (absent, set()):
+                members = {i for i in ids if i not in grow}
+                for nid in ids:  # every node evaluates this vote set
+                    own = qsets[nid]
+                    verdicts += LN.is_quorum(
+                        members, lambda _m, q=own: q, local_qset=own)
+        return verdicts
+
+    results = {}
+    for arm, enabled in (("vectorized", True), ("scalar", False)):
+        qset_vector.clear_caches()
+        qset_vector.set_enabled(enabled)
+        try:
+            warm = workload()
+            t0 = time.perf_counter()
+            verdicts = workload()
+            wall = time.perf_counter() - t0
+        finally:
+            qset_vector.set_enabled(True)
+        assert warm == verdicts
+        results[arm] = {"wall_s": round(wall, 4), "verdicts": verdicts}
+    results["evaluations"] = rounds * 2 * n
+    results["speedup"] = round(
+        results["scalar"]["wall_s"]
+        / max(results["vectorized"]["wall_s"], 1e-9), 2)
+    results["verdicts_agree"] = (
+        results["scalar"]["verdicts"] == results["vectorized"]["verdicts"])
+    results["vector_stats"] = dict(qset_vector.stats)
+    return results
+
+
+# ---------------------------------------------------------------------------
+# fleet-scale schedule runs
+# ---------------------------------------------------------------------------
+
+def _fleet_schedule(seed0: int, n_orgs: int, per_org: int) -> dict:
+    """First fleet-profile schedule at/after ``seed0`` whose sampled
+    topology matches the requested tier (generation is cheap; running
+    is not)."""
+    for seed in range(seed0, seed0 + 512):
+        sched = S.generate_schedule(seed, "fleet")
+        topo = sched["topology"]
+        if topo.get("n_orgs") == n_orgs and topo.get("per_org") == per_org:
+            return sched
+    raise RuntimeError(
+        f"no fleet schedule with {n_orgs}x{per_org} in 512 seeds")
+
+
+def bench_fleet(seed0: int, n_orgs: int, per_org: int) -> dict:
+    sched = _fleet_schedule(seed0, n_orgs, per_org)
+    t0 = time.perf_counter()
+    res = run_schedule(sched)
+    wall = time.perf_counter() - t0
+    rep = res.get("report") or {}
+    virtual = rep.get("virtual_elapsed_s") or float(sched["duration"])
+    out = {
+        "validators": n_orgs * per_org,
+        "schedule_id": res["schedule_id"],
+        "seed": sched["seed"],
+        "events": [e["kind"] for e in sched["events"]],
+        "ok": res["ok"],
+        "failure_class": res["failure_class"],
+        "wall_s": round(wall, 2),
+        "virtual_s": virtual,
+        "wall_s_per_virtual_s": round(wall / max(virtual, 1e-9), 3),
+        "ledgers_closed": rep.get("ledgers_closed"),
+        "time_to_heal_s": rep.get("time_to_heal_s"),
+    }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the known-bad proof
+# ---------------------------------------------------------------------------
+
+def prove_schedule(sched: dict, traces_dir: str,
+                   minimize_budget: int = 32) -> dict:
+    """Run one failing schedule through the full pipeline — found ->
+    ddmin-minimized -> persisted to ``traces_dir`` -> replayed from the
+    artifact — and report every stage's verdict."""
+    found = run_schedule(sched)
+    proof = {
+        "schedule_id": schedule_id(sched),
+        "found": not found["ok"],
+        "failure_class": found["failure_class"],
+        "events_before": len(sched["events"]),
+    }
+    if found["ok"]:
+        return proof
+    mini, stats = minimize_schedule(
+        sched, target_class=found["failure_class"],
+        max_runs=minimize_budget)
+    proof.update({
+        "events_after": len(mini["events"]),
+        "minimized_events": [e["kind"] for e in mini["events"]],
+        "oracle_runs": stats["oracle_runs"],
+        "minimized_reproduces": stats["reproduces"],
+    })
+    if not stats["reproduces"]:
+        return proof
+    path = write_repro(mini, dict(stats["final_result"], ok=False),
+                       out_dir=traces_dir,
+                       minimized_from=schedule_id(sched))
+    verdict = verify_repro(load_schedule(path))
+    proof.update({
+        "repro_path": path,
+        "replay_reproduced": verdict["reproduced"],
+        "failure_fingerprint":
+            verdict["expected"]["failure_fingerprint"],
+    })
+    return proof
+
+
+def prove_known_bad(traces_dir: str, minimize_budget: int = 32) -> dict:
+    return prove_schedule(known_bad_schedule(), traces_dir,
+                          minimize_budget)
+
+
+def real_finding_schedule() -> dict:
+    """An ACTUAL bug the chaos grammar surfaced (not an injected
+    canary): on a deliberately-unsafe core-4 (threshold 2 — quorums
+    need not intersect), equivocating+silencing one node while an
+    honest node is partitioned away forks the network, and a node then
+    applies a tx set built on the OTHER branch — ledger close dies
+    with ``tx set prev hash mismatch`` (crash:RuntimeError),
+    deterministically.  The full bench minimizes it and persists the
+    repro to ``traces/`` like any campaign finding."""
+    sched = {
+        "fuzz_schema": S.SCHEMA_VERSION,
+        "seed": 14,
+        "profile": "real-finding",
+        "topology": {"kind": "core", "n": 4, "threshold": 2},
+        "duration": 14.0,
+        "converge_timeout": 20.0,
+        "events": [
+            {"t": 2.0, "kind": "equivocate", "victim": 2},
+            {"t": 2.0, "kind": "silence", "victim": 2},
+            {"t": 3.0, "kind": "partition", "groups": [[3], [0, 1]]},
+        ],
+        "traffic": [],
+    }
+    S.validate_schedule(sched)
+    return sched
+
+
+# ---------------------------------------------------------------------------
+# main
+# ---------------------------------------------------------------------------
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description="fuzz campaign bench")
+    ap.add_argument("--smoke", action="store_true",
+                    help="budget-capped verify_green gate")
+    ap.add_argument("--out", default=None, help="output JSON path")
+    ap.add_argument("--seed0", type=int, default=9000)
+    ap.add_argument("--skip-fleet", action="store_true")
+    ap.add_argument("--skip-ab", action="store_true")
+    args = ap.parse_args()
+    out_path = args.out or OUT
+
+    doc = {"bench": "fuzz_campaign", "revision": "r20",
+           "smoke": bool(args.smoke)}
+    problems = []
+    t_start = time.perf_counter()
+
+    with tempfile.TemporaryDirectory(prefix="fuzz-bench-") as tmp:
+        traces_dir = tmp if args.smoke else "traces"
+
+        # 1. campaign throughput + corpus stats
+        profiles = (("smoke", 4),) if args.smoke else \
+            (("smoke", 6), ("default", 8))
+        doc["campaigns"] = {}
+        for profile, count in profiles:
+            camp = FuzzCampaign(
+                seed0=args.seed0, profile=profile, schedules=count,
+                wall_budget_s=180.0 if args.smoke else 900.0,
+                corpus_dir=os.path.join(tmp, f"corpus-{profile}"),
+                traces_dir=traces_dir,
+                minimize_budget=16 if args.smoke else 32,
+                log=lambda s: print(s, flush=True))
+            summary = camp.run()
+            doc["campaigns"][profile] = summary
+            for f in summary["failures"]:
+                if f.get("non_reproducing"):
+                    problems.append(
+                        f"campaign[{profile}] seed {f['seed']}: minimized "
+                        f"schedule does not reproduce "
+                        f"{f['failure_class']!r}")
+                else:
+                    # a reproducing minimized failure is a FINDING —
+                    # the bench records it; the smoke gate stays green
+                    # only for the known-bad class the fuzzer plants,
+                    # anything else is a real red flag
+                    problems.append(
+                        f"campaign[{profile}] seed {f['seed']}: oracle "
+                        f"failure {f['failure_class']!r} "
+                        f"(repro: {f.get('repro_path')})")
+
+        # 2. known-bad: found -> minimized -> replayed
+        print("[bench] known-bad proof", flush=True)
+        doc["known_bad"] = prove_known_bad(
+            traces_dir, minimize_budget=16 if args.smoke else 32)
+        kb = doc["known_bad"]
+        if not kb["found"]:
+            problems.append("known-bad schedule did not fail its oracles")
+        elif not kb.get("minimized_reproduces"):
+            problems.append("known-bad minimized schedule does not "
+                            "reproduce the failure")
+        elif not kb.get("replay_reproduced"):
+            problems.append("known-bad repro artifact does not replay "
+                            "to the same fingerprint")
+
+        # 2b. the real finding: the crash bug the grammar surfaced,
+        # minimized + persisted like any campaign discovery
+        if not args.smoke:
+            print("[bench] real finding (tx set prev hash mismatch)",
+                  flush=True)
+            doc["real_finding"] = prove_schedule(
+                real_finding_schedule(), traces_dir, minimize_budget=32)
+            rf = doc["real_finding"]
+            if not rf["found"]:
+                problems.append(
+                    "real-finding schedule did not fail its oracles")
+            elif not rf.get("replay_reproduced"):
+                problems.append(
+                    "real-finding repro artifact does not replay")
+
+        # 3. vectorized-vs-scalar A/B at 50+ validators
+        if not args.skip_ab:
+            print("[bench] slice-eval A/B", flush=True)
+            doc["slice_eval_ab"] = {
+                "50": bench_slice_eval(10, 5,
+                                       rounds=10 if args.smoke else 40),
+            }
+            if not args.smoke:
+                doc["slice_eval_ab"]["100"] = bench_slice_eval(
+                    20, 5, rounds=20)
+            for tier, ab in doc["slice_eval_ab"].items():
+                if not ab["verdicts_agree"]:
+                    problems.append(
+                        f"A/B at {tier}: vectorized and scalar verdicts "
+                        f"disagree")
+                if ab["speedup"] < 2.0:
+                    problems.append(
+                        f"A/B at {tier}: speedup {ab['speedup']}x < 2x")
+
+        # 4. fleet-scale schedules (50 and 100 validators)
+        if not args.skip_fleet and not args.smoke:
+            print("[bench] fleet 50", flush=True)
+            doc["fleet"] = {"50": bench_fleet(args.seed0, 10, 5)}
+            print("[bench] fleet 100", flush=True)
+            doc["fleet"]["100"] = bench_fleet(args.seed0, 20, 5)
+            for tier, f in doc["fleet"].items():
+                if not f["ok"]:
+                    problems.append(
+                        f"fleet {tier}-validator schedule "
+                        f"{f['schedule_id']} failed: {f['failure_class']}")
+
+    doc["wall_s"] = round(time.perf_counter() - t_start, 1)
+    doc["problems"] = problems
+    doc["green"] = not problems
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"[bench] wrote {out_path} "
+          f"({'GREEN' if doc['green'] else 'RED'}, {doc['wall_s']}s)")
+    for p in problems:
+        print(f"  PROBLEM: {p}")
+    return 0 if doc["green"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Replay a persisted fuzz repro artifact and check replay identity.
+
+A repro artifact (``traces/FUZZ_REPRO_*.json``, written by the fuzz
+campaign's minimizer) carries a minimized schedule plus the failure
+class and fingerprint it is expected to reproduce.  This tool re-runs
+the schedule under the full oracle stack and compares:
+
+- exit 0: the run failed with the SAME class and the SAME failure
+  fingerprint (replay identity holds),
+- exit 1: the run passed, or failed differently (the repro rotted),
+- exit 2: the artifact itself is invalid (corrupted JSON, oversized,
+  unknown schema, schedule fails validation).
+
+Usage:
+    python -m tools.fuzz_repro traces/FUZZ_REPRO_fork_<id>.json
+    python -m tools.fuzz_repro --json <file>     # machine-readable
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stellar_core_tpu.simulation.fuzz.minimize import verify_repro  # noqa: E402
+from stellar_core_tpu.simulation.fuzz.schedule import (  # noqa: E402
+    ScheduleError, load_schedule, schedule_id)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="replay a fuzz repro artifact and check its "
+                    "failure class + fingerprint")
+    ap.add_argument("repro", help="path to a FUZZ_REPRO_*.json artifact")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the verdict as JSON")
+    args = ap.parse_args()
+
+    try:
+        # strict loader: size cap, JSON parse, schedule validation
+        doc = load_schedule(args.repro)
+        if not isinstance(doc, dict) or "fuzz_repro_schema" not in doc:
+            raise ScheduleError(
+                f"{args.repro}: not a fuzz repro artifact "
+                f"(missing fuzz_repro_schema)")
+        sched = doc["schedule"]
+    except (OSError, ValueError) as e:  # ScheduleError is a ValueError
+        print(f"INVALID: {e}", file=sys.stderr)
+        return 2
+
+    sid = schedule_id(sched)
+    if not args.json:
+        print(f"replaying schedule {sid} "
+              f"(expect {doc['expect']['failure_class']!r}) ...")
+    verdict = verify_repro(doc)
+    if args.json:
+        print(json.dumps(verdict, indent=1, sort_keys=True))
+    else:
+        exp, got = verdict["expected"], verdict["got"]
+        print(f"expected: {exp['failure_class']} "
+              f"{exp['failure_fingerprint'][:16]}")
+        print(f"got:      {got['failure_class']} "
+              f"{(got['failure_fingerprint'] or '-')[:16]}")
+        print("REPRODUCED" if verdict["reproduced"] else "NOT REPRODUCED")
+    return 0 if verdict["reproduced"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,15 +22,36 @@ BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "baseline.json")
 
 # consensus-critical module prefixes (relative to the package root):
-# nondeterminism here forks validators (ISSUE 3)
+# nondeterminism here forks validators (ISSUE 3).  Entries may be
+# nested ("simulation/fuzz"): a path is covered when its leading
+# components match every component of the entry — the fuzzer's
+# schedule IR and executor must stay deterministic (same-seed replay
+# identity is the repro contract) without dragging all of simulation/
+# into the consensus ruleset.
 CONSENSUS_DIRS = ("scp", "herder", "ledger", "bucket", "transactions",
-                  "xdr", "crypto", "apply", "catchup", "history", "work")
+                  "xdr", "crypto", "apply", "catchup", "history", "work",
+                  "simulation/fuzz")
 # device-kernel modules: host-side effects inside jax.jit break
 # trace/replay determinism
 KERNEL_DIRS = ("ops",)
 
 _PRAGMA_RE = re.compile(r"#\s*detlint:\s*allow\(([^)]*)\)")
 _GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+
+
+def path_under(path: str, dirs: Sequence[str]) -> bool:
+    """Is ``path`` (repo-relative, '/'-separated) inside one of the
+    package-relative ``dirs``?  Entries may themselves contain slashes
+    ("simulation/fuzz") and match a leading component sequence."""
+    parts = path.split("/")
+    if PACKAGE not in parts:
+        return False
+    rest = parts[parts.index(PACKAGE) + 1:]
+    for d in dirs:
+        want = d.split("/")
+        if rest[:len(want)] == want:
+            return True
+    return False
 
 
 def dotted_name(node: ast.AST) -> Optional[str]:
@@ -87,11 +108,7 @@ class FileInfo:
         return self._under(KERNEL_DIRS)
 
     def _under(self, dirs: Sequence[str]) -> bool:
-        parts = self.path.split("/")
-        if PACKAGE not in parts:
-            return False
-        rest = parts[parts.index(PACKAGE) + 1:]
-        return bool(rest) and rest[0] in dirs
+        return path_under(self.path, dirs)
 
 
 class ContextVisitor(ast.NodeVisitor):

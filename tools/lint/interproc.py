@@ -34,15 +34,12 @@ from typing import Dict, List, Optional, Tuple
 
 from . import callgraph
 from .callgraph import INTERPROC_RULE, MAX_TAINT_DEPTH, Graph
-from .engine import CONSENSUS_DIRS, PACKAGE, FileInfo, Finding
+from .engine import (CONSENSUS_DIRS, PACKAGE, FileInfo, Finding,
+                     path_under)
 
 
 def _in_consensus(path: str) -> bool:
-    parts = path.split("/")
-    if PACKAGE not in parts:
-        return False
-    rest = parts[parts.index(PACKAGE) + 1:]
-    return bool(rest) and rest[0] in CONSENSUS_DIRS
+    return path_under(path, CONSENSUS_DIRS)
 
 
 class Taint:

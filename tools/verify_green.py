@@ -55,9 +55,15 @@ Usage: python tools/verify_green.py            -> exit 0 iff green
            network-observatory gate (tools/chaos_bench.py --netobs
            --tier core4: hop records nonzero, coverage percentiles
            present, crank attribution >= 90%, tracing overhead < 2%,
-           on/off hash+meta inertness).
+           on/off hash+meta inertness); --skip-fuzz-smoke skips the
+           fault-schedule-fuzzer gate (tools/fuzz_bench.py --smoke:
+           budget-capped seeded schedules on core-4 + one tiered net
+           under the full oracle stack, plus the known-bad ->
+           ddmin-minimize -> replay-identical proof).
        python tools/verify_green.py --netobs-smoke -> ONLY the
            network-observatory gate above.
+       python tools/verify_green.py --fuzz-smoke -> ONLY the
+           fault-schedule-fuzzer gate above.
        python tools/verify_green.py --lockdep-smoke -> ONLY the
            runtime witness gate: the threaded-subsystem tier-1 subset,
            one core-4 chaos scenario and one pipelined-close bench
@@ -757,6 +763,45 @@ def run_lockdep_smoke() -> "tuple":
     return problems, summary
 
 
+def run_fuzz_smoke() -> "tuple":
+    """The fault-schedule fuzzer gate (tools/fuzz_bench.py --smoke): a
+    budget-capped campaign of seeded schedules on the smoke grid
+    (core-4 + one tiered net) under the full oracle stack, plus the
+    known-bad proof — the injected fork schedule must be found,
+    ddmin-minimized to its essential events, and its persisted repro
+    artifact must replay to the same failure fingerprint.  Red on any
+    oracle failure and on a non-reproducing minimized artifact.
+    Returns (problems, summary)."""
+    out = "/tmp/_t1_fuzz_smoke.json"
+    cmd = [sys.executable, "-m", "tools.fuzz_bench", "--smoke",
+           "--out", out]
+    print(f"verify_green: [fuzz smoke] {' '.join(cmd)}", flush=True)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=900)
+    try:
+        with open(out) as f:
+            rep = json.load(f)
+    except (OSError, ValueError) as e:
+        tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-6:])
+        return [f"fuzz smoke report unreadable: {e}: {tail}"], "failed"
+    problems = [f"fuzz smoke: {p}" for p in rep.get("problems", [])]
+    if proc.returncode != 0 and not problems:
+        tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-6:])
+        problems.append(f"fuzz smoke exited {proc.returncode}: {tail}")
+    camp = rep.get("campaigns", {}).get("smoke", {})
+    kb = rep.get("known_bad", {})
+    ab = rep.get("slice_eval_ab", {}).get("50", {})
+    summary = (f"{camp.get('schedules_executed')} schedules "
+               f"({camp.get('failure_count')} failures, "
+               f"{camp.get('unique_novelty')} novel), known-bad "
+               f"{kb.get('events_before')}->{kb.get('events_after')} "
+               f"events, replay={kb.get('replay_reproduced')}, "
+               f"A/B@50 {ab.get('speedup')}x")
+    return problems, summary
+
+
 def main() -> int:
     timings = "--timings" in sys.argv
     if "--lint-only" in sys.argv:
@@ -794,6 +839,18 @@ def main() -> int:
         print(f"verify_green: GREEN (lockdep smoke: {ld_summary})",
               flush=True)
         return 0
+    if "--fuzz-smoke" in sys.argv:
+        # standalone fault-schedule-fuzzer gate: budget-capped seeded
+        # schedules + the known-bad minimize/replay proof
+        fz_problems, fz_summary = run_fuzz_smoke()
+        print(f"verify_green: fuzz smoke: {fz_summary}", flush=True)
+        if fz_problems:
+            print(f"verify_green: RED ({'; '.join(fz_problems)})",
+                  flush=True)
+            return 1
+        print(f"verify_green: GREEN (fuzz smoke: {fz_summary})",
+              flush=True)
+        return 0
     smoke_only = "--parallel-smoke-only" in sys.argv
     skip_smoke = "--skip-parallel-smoke" in sys.argv
     skip_fallback = "--skip-fallback-smoke" in sys.argv
@@ -806,6 +863,7 @@ def main() -> int:
     skip_catchup = "--skip-catchup-smoke" in sys.argv
     skip_lockdep = "--skip-lockdep-smoke" in sys.argv
     skip_netobs = "--skip-netobs-smoke" in sys.argv
+    skip_fuzz = "--skip-fuzz-smoke" in sys.argv
     if smoke_only:
         cmd = tier1_command()
         problems, passed, summary = run_parallel_smoke(cmd)
@@ -922,6 +980,11 @@ def main() -> int:
         print(f"verify_green: lockdep smoke: {ld_summary}", flush=True)
         problems.extend(ld_problems)
         smoke_note += f", lockdep smoke: {ld_summary}"
+    if not skip_fuzz:
+        fz_problems, fz_summary = run_fuzz_smoke()
+        print(f"verify_green: fuzz smoke: {fz_summary}", flush=True)
+        problems.extend(fz_problems)
+        smoke_note += f", fuzz smoke: {fz_summary}"
     if problems:
         print(f"verify_green: RED ({'; '.join(problems)}); "
               f"passed={passed}", flush=True)
